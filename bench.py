@@ -1,0 +1,87 @@
+"""Headline benchmark: FL rounds/sec simulating 10k clients, 4-layer CNN on
+CIFAR-10-shaped data (BASELINE.md: >=500 rounds/min over 10k clients on a
+v4-32, i.e. ~0.26 rounds/sec per chip).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is measured per-chip rounds/sec divided by the reference
+target's per-chip rounds/sec (500/60/32), so >1.0 means beating the v4-32
+target on a chip-for-chip basis.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+BASELINE_ROUNDS_PER_SEC_PER_CHIP = 500.0 / 60.0 / 32.0  # BASELINE.md target
+
+
+def main():
+    on_cpu = jax.default_backend() == "cpu"
+    num_clients = 512 if on_cpu else 10_000
+    n_local = 8 if on_cpu else 20
+    block = 32 if on_cpu else 256
+    local_steps = 2 if on_cpu else 10
+    batch = 8 if on_cpu else 32
+    timed_rounds = 2 if on_cpu else 3
+
+    plan = make_mesh_plan()
+    cfg = FedCoreConfig(batch_size=batch, max_local_steps=local_steps, block_clients=block)
+    core = build_fedcore("cnn4", fedavg(0.05), plan, cfg)
+
+    ds = make_synthetic_dataset(
+        seed=0,
+        num_clients=num_clients,
+        n_local=n_local,
+        input_shape=(32, 32, 3),
+        num_classes=10,
+        dirichlet_alpha=0.5,
+    ).pad_for(plan, block).place(plan)
+
+    state = core.init_state(jax.random.key(0))
+
+    # Warmup: compile + one round. float() forces a host transfer — a real
+    # synchronization barrier even on relay/tunnel platforms where
+    # block_until_ready returns early.
+    state, metrics = core.round_step(state, ds)
+    float(metrics.mean_loss)
+
+    t0 = time.perf_counter()
+    for _ in range(timed_rounds):
+        state, metrics = core.round_step(state, ds)
+    last_loss = float(metrics.mean_loss)
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = timed_rounds / dt
+    n_chips = len(jax.devices())
+    per_chip = rounds_per_sec / n_chips
+    result = {
+        "metric": f"FL rounds/sec, {num_clients} clients x {local_steps} local steps, cnn4/CIFAR-10 shapes",
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(per_chip / BASELINE_ROUNDS_PER_SEC_PER_CHIP, 4),
+        "detail": {
+            "device_rounds_per_sec": round(num_clients * rounds_per_sec, 1),
+            "chips": n_chips,
+            "backend": jax.default_backend(),
+            "round_time_sec": round(dt / timed_rounds, 4),
+            "mean_loss": last_loss,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""olearning_sim_tpu — TPU-native device-cloud simulation framework.
+
+A ground-up rebuild of the capabilities of ``opas-lab/olearning-sim`` (SimDC,
+ICDCS 2025): a high-fidelity simulation platform for device-cloud collaborative
+computing (federated learning at mobile-device scale). Where the reference runs
+one CPU subprocess per simulated device step
+(``ols_core/taskMgr/utils/utils_run_task.py:496-514``), this framework advances
+*all* virtual devices in one compiled XLA program per (round x operator):
+clients are vmapped and sharded over a ``jax.sharding.Mesh``, FedAvg and other
+aggregations are XLA collectives over ICI, and deviceflow behavior traces
+(churn / drops / access spikes) are compiled to per-client masks instead of
+Pulsar message schedules.
+
+Top-level layout (mirrors the reference's layer map, SURVEY.md section 1):
+
+- ``models/``      Flax model zoo (MLP, CNN, ResNet, Transformer, ViT).
+- ``engine/``      the execution engine: client state, local training,
+                   ``round_step`` (the compiled hot path), FL algorithms.
+- ``parallel/``    mesh construction, sharding plans, collectives.
+- ``ops/``         Pallas kernels and fused ops for the hot path.
+- ``deviceflow/``  device-behavior middleware: strategy grammar, trace
+                   compiler (schedules -> masks), flow lifecycle service.
+- ``taskmgr/``     task lifecycle: queue, scheduler, runner, validation,
+                   codecs, operator flow.
+- ``resourcemgr/`` TPU resource ledger (chips/cores instead of cpu/mem).
+- ``clustermgr/``  multi-host cluster provisioning analogue.
+- ``storage/``     file repositories (local/HTTP/S3/MinIO-compatible).
+- ``utils/``       logging, state repos, checkpointing, metrics.
+"""
+
+__version__ = "0.1.0"

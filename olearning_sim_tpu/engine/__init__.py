@@ -1,0 +1,21 @@
+from olearning_sim_tpu.engine.client_data import ClientDataset, make_synthetic_dataset
+from olearning_sim_tpu.engine.algorithms import Algorithm, fedavg, fedprox, fedadam
+from olearning_sim_tpu.engine.fedcore import (
+    FedCore,
+    RoundMetrics,
+    ServerState,
+    build_fedcore,
+)
+
+__all__ = [
+    "Algorithm",
+    "ClientDataset",
+    "FedCore",
+    "RoundMetrics",
+    "ServerState",
+    "build_fedcore",
+    "fedavg",
+    "fedprox",
+    "fedadam",
+    "make_synthetic_dataset",
+]

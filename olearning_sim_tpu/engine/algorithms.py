@@ -1,0 +1,79 @@
+"""Federated-learning algorithm definitions.
+
+In the reference, the FL algorithm lives inside user operator code and an
+external cloud aggregation service — the platform only transports updates
+(SURVEY.md section 2.5). Here the algorithm is a first-class declarative
+object consumed by :mod:`olearning_sim_tpu.engine.fedcore`:
+
+- ``local_optimizer``  — optax transform run on-device per client.
+- ``server_optimizer`` — optax transform applied to the aggregated
+  pseudo-gradient (negative mean delta), generalizing FedAvg (SGD(1.0)),
+  FedAdam/FedYogi (adaptive server), FedAvgM (server momentum).
+- ``prox_mu``          — FedProx proximal coefficient added to the local loss.
+- ``personalized``     — Ditto-style: keep per-client personalized params that
+  train alongside the global ones with an L2 pull toward the global model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class Algorithm:
+    name: str
+    local_optimizer: optax.GradientTransformation
+    server_optimizer: optax.GradientTransformation
+    prox_mu: float = 0.0
+    # Ditto personalization (BASELINE config 5)
+    personalized: bool = False
+    ditto_lambda: float = 0.0
+
+
+def fedavg(local_lr: float = 0.05, server_lr: float = 1.0, server_momentum: float = 0.0) -> Algorithm:
+    server = (
+        optax.sgd(server_lr, momentum=server_momentum)
+        if server_momentum
+        else optax.sgd(server_lr)
+    )
+    return Algorithm("fedavg", optax.sgd(local_lr), server)
+
+
+def fedprox(local_lr: float = 0.05, mu: float = 0.01, server_lr: float = 1.0) -> Algorithm:
+    return Algorithm("fedprox", optax.sgd(local_lr), optax.sgd(server_lr), prox_mu=mu)
+
+
+def fedadam(
+    local_lr: float = 0.05,
+    server_lr: float = 1e-2,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    eps: float = 1e-3,
+) -> Algorithm:
+    return Algorithm("fedadam", optax.sgd(local_lr), optax.adam(server_lr, b1=b1, b2=b2, eps=eps))
+
+
+def ditto(local_lr: float = 0.05, lam: float = 0.1, server_lr: float = 1.0) -> Algorithm:
+    return Algorithm(
+        "ditto",
+        optax.sgd(local_lr),
+        optax.sgd(server_lr),
+        personalized=True,
+        ditto_lambda=lam,
+    )
+
+
+_FACTORIES = {
+    "fedavg": fedavg,
+    "fedprox": fedprox,
+    "fedadam": fedadam,
+    "ditto": ditto,
+}
+
+
+def from_config(name: str, **kwargs) -> Algorithm:
+    if name not in _FACTORIES:
+        raise KeyError(f"unknown algorithm {name!r}; known: {sorted(_FACTORIES)}")
+    return _FACTORIES[name](**kwargs)

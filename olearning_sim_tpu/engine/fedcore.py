@@ -1,0 +1,360 @@
+"""FedCore — the compiled FL round engine (the TPU replacement for the
+reference's execution layer).
+
+Reference semantics being replaced (SURVEY.md sections 2.2, 3.3):
+
+- ``Actor.loop_run`` runs one Python subprocess per virtual phone per step
+  (``ols_core/taskMgr/utils/utils_run_task.py:481-514``) — here each round is
+  ONE jitted XLA program that advances every client.
+- ``construct_run_params`` splits N virtual devices over M Ray actors
+  (``ols_core/taskMgr/run_task.py:62-106``) — here clients are sharded over
+  the mesh ``dp`` axis and vmapped in blocks inside ``shard_map``.
+- Gradient shipping via Pulsar + external aggregation
+  (``ols_core/deviceflow/non_grpc/sorter.py:37-92``, ``dispatcher.py:84-242``)
+  — here the weighted-delta reduction is a ``psum`` over ICI.
+
+Program shape::
+
+    round_step = jit( shard_map( scan over client blocks:
+                                     vmap over clients:
+                                         lax.scan over local SGD steps
+                                 -> psum(weighted deltas) )
+                      -> server optimizer update )
+
+Heterogeneity (per-client local-step counts / data sizes) is handled with
+masking: step ``i`` is active iff ``i < num_steps[c]``; minibatch indices are
+drawn in ``[0, num_samples[c])``; aggregation weights are 0 for padded or
+non-participating clients. Behavior traces (churn/drop/spike) enter purely as
+the ``weight``/``num_steps`` arrays, produced by the deviceflow trace compiler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from olearning_sim_tpu.engine.algorithms import Algorithm
+from olearning_sim_tpu.engine.client_data import ClientDataset
+from olearning_sim_tpu.parallel.mesh import MeshPlan
+
+
+class ServerState(struct.PyTreeNode):
+    """Global FL state carried across rounds (the checkpointable unit —
+    reference analogue: ``{task_id}_{round}_result_model.mnn`` round-scoped
+    model files, ``utils_run_task.py:327-397``)."""
+
+    params: Any
+    opt_state: Any
+    round_idx: jnp.ndarray  # int32 scalar
+    base_key: jax.Array     # PRNG key; per-client streams fold in (uid, round)
+
+
+class RoundMetrics(struct.PyTreeNode):
+    """Per-round aggregates (reference analogue: ``analyze_results`` success /
+    failure accounting persisted to MySQL, ``run_task.py:149-210``)."""
+
+    mean_loss: jnp.ndarray      # weight-averaged local training loss
+    weight_sum: jnp.ndarray     # total aggregation weight (participants)
+    clients_trained: jnp.ndarray  # number of clients with weight > 0
+
+
+@dataclasses.dataclass(frozen=True)
+class FedCoreConfig:
+    batch_size: int = 32
+    max_local_steps: int = 10
+    # Clients vmapped at once per device; the scan over blocks bounds peak HBM
+    # (activations scale with block_clients * batch_size, not population size).
+    block_clients: int = 64
+    eval_batch_size: int = 1024
+
+
+def _to_varying(tree, axis: str):
+    """Type a replicated value as device-varying over ``axis`` (shard_map VMA).
+
+    Needed for scan carries that start replicated (e.g. global params) but
+    accumulate shard-local data inside ``shard_map``.
+    """
+    try:
+        return jax.lax.pcast(tree, (axis,), to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(tree, axis)
+
+
+def _tree_where(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _tree_l2_sq(a, b):
+    leaves = jax.tree.map(lambda x, y: jnp.sum(jnp.square(x - y)), a, b)
+    return jax.tree.reduce(jnp.add, leaves, jnp.float32(0.0))
+
+
+class FedCore:
+    """Builds and owns the jitted round/eval programs for one (model,
+    algorithm, mesh) triple."""
+
+    def __init__(
+        self,
+        apply_fn: Callable[[Any, jax.Array], jax.Array],
+        init_params_fn: Callable[[jax.Array], Any],
+        algorithm: Algorithm,
+        plan: MeshPlan,
+        config: FedCoreConfig = FedCoreConfig(),
+    ):
+        if algorithm.personalized:
+            raise NotImplementedError(
+                "Ditto-style personalization lands with the personalized state "
+                "container; use fedavg/fedprox/fedadam here for now."
+            )
+        self.apply_fn = apply_fn
+        self.init_params_fn = init_params_fn
+        self.algorithm = algorithm
+        self.plan = plan
+        self.config = config
+        self._round_step = self._build_round_step()
+        self._evaluate = self._build_evaluate()
+
+    # ------------------------------------------------------------------ init
+    def init_state(self, rng: jax.Array) -> ServerState:
+        pk, bk = jax.random.split(rng)
+        params = self.init_params_fn(pk)
+        opt_state = self.algorithm.server_optimizer.init(params)
+        state = ServerState(
+            params=params,
+            opt_state=opt_state,
+            round_idx=jnp.int32(0),
+            base_key=bk,
+        )
+        return jax.device_put(state, self.plan.replicated())
+
+    # ------------------------------------------------------- local training
+    def _local_train(self, global_params, x, y, num_samples, num_steps, uid,
+                     base_key, round_idx):
+        """One client's local training: masked lax.scan over SGD steps.
+
+        Per-client RNG stream: fold_in(fold_in(base_key, uid), round) — stable
+        under any resharding of clients to devices, which is what makes the
+        accuracy-parity claim reproducible (SURVEY.md section 7 hard parts).
+        """
+        cfg = self.config
+        alg = self.algorithm
+        key = jax.random.fold_in(jax.random.fold_in(base_key, uid), round_idx)
+        opt_state = alg.local_optimizer.init(global_params)
+        n = jnp.maximum(num_samples, 1)
+        # The scan length is static; clamp so a larger requested step count is
+        # an explicit cap, and metrics divide by the steps actually run.
+        steps_eff = jnp.minimum(num_steps, cfg.max_local_steps)
+
+        def loss_fn(p, xb, yb):
+            logits = self.apply_fn(p, xb)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+            if alg.prox_mu:
+                loss = loss + 0.5 * alg.prox_mu * _tree_l2_sq(p, global_params)
+            return loss
+
+        def step(carry, i):
+            params, opt_state = carry
+            k = jax.random.fold_in(key, i)
+            idx = jax.random.randint(k, (cfg.batch_size,), 0, n)
+            xb = jnp.take(x, idx, axis=0)
+            yb = jnp.take(y, idx, axis=0)
+            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+            updates, new_opt = alg.local_optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            active = i < steps_eff
+            carry = _tree_where(active, (new_params, new_opt), (params, opt_state))
+            return carry, jnp.where(active, loss, 0.0)
+
+        (params, _), losses = jax.lax.scan(
+            step,
+            _to_varying((global_params, opt_state), "dp"),
+            jnp.arange(cfg.max_local_steps),
+        )
+        delta = jax.tree.map(jnp.subtract, params, global_params)
+        mean_loss = losses.sum() / jnp.maximum(steps_eff, 1).astype(jnp.float32)
+        return delta, mean_loss
+
+    # ----------------------------------------------------------- round step
+    # NOTE on the mp axis: model params are currently replicated, so mp > 1
+    # duplicates client work rather than splitting tensors. mp becomes a real
+    # tensor-parallel axis with the transformer families; keep mp=1 for
+    # throughput benchmarking until then.
+    def _build_round_step(self):
+        plan = self.plan
+        cfg = self.config
+        alg = self.algorithm
+        mesh = plan.mesh
+
+        def shard_body(params, opt_state, round_idx, base_key,
+                       x, y, num_samples, num_steps, uid, weight):
+            c_local = x.shape[0]
+            if c_local % cfg.block_clients != 0:
+                raise ValueError(
+                    f"per-device client count {c_local} must be a multiple of "
+                    f"block_clients={cfg.block_clients}; pad the dataset with "
+                    f"ClientDataset.pad_for(plan, block=config.block_clients)"
+                )
+            nb = c_local // cfg.block_clients
+
+            def blocked(a):
+                return a.reshape((nb, cfg.block_clients) + a.shape[1:])
+
+            xs = (blocked(x), blocked(y), blocked(num_samples),
+                  blocked(num_steps), blocked(uid), blocked(weight))
+
+            zero_delta = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            init = (zero_delta, jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+            # The carry accumulates device-varying values (per-shard client
+            # sums), so its initial value must be typed as varying over dp.
+            init = _to_varying(init, "dp")
+
+            def block_step(carry, inp):
+                sum_delta, sum_w, sum_loss, count = carry
+                bx, by, bns, bst, buid, bw = inp
+                deltas, losses = jax.vmap(
+                    self._local_train,
+                    in_axes=(None, 0, 0, 0, 0, 0, None, None),
+                )(params, bx, by, bns, bst, buid, base_key, round_idx)
+                sum_delta = jax.tree.map(
+                    lambda s, d: s + jnp.tensordot(bw, d.astype(jnp.float32), axes=(0, 0)),
+                    sum_delta, deltas,
+                )
+                sum_w = sum_w + bw.sum()
+                sum_loss = sum_loss + (bw * losses).sum()
+                count = count + (bw > 0).sum().astype(jnp.float32)
+                return (sum_delta, sum_w, sum_loss, count), None
+
+            (sum_delta, sum_w, sum_loss, count), _ = jax.lax.scan(block_step, init, xs)
+
+            # Cross-device FedAvg: the Pulsar gradient transport of the
+            # reference becomes one psum over the dp axis of the ICI mesh.
+            sum_delta = jax.lax.psum(sum_delta, "dp")
+            sum_w = jax.lax.psum(sum_w, "dp")
+            sum_loss = jax.lax.psum(sum_loss, "dp")
+            count = jax.lax.psum(count, "dp")
+
+            denom = jnp.maximum(sum_w, 1e-8)
+            mean_delta = jax.tree.map(lambda s: s / denom, sum_delta)
+            # Server optimizer consumes the negative mean delta as a
+            # pseudo-gradient (FedOpt formulation).
+            pseudo_grad = jax.tree.map(
+                lambda d, p: (-d).astype(p.dtype), mean_delta, params
+            )
+            updates, new_opt_state = alg.server_optimizer.update(
+                pseudo_grad, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+            metrics = RoundMetrics(
+                mean_loss=sum_loss / denom,
+                weight_sum=sum_w,
+                clients_trained=count,
+            )
+            return new_params, new_opt_state, round_idx + 1, metrics
+
+        rep = P()
+        cl = P("dp")
+        shard_fn = jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl),
+            out_specs=(rep, rep, rep, rep),
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def round_step(state: ServerState, x, y, num_samples, num_steps, uid, weight):
+            new_params, new_opt_state, new_round, metrics = shard_fn(
+                state.params, state.opt_state, state.round_idx, state.base_key,
+                x, y, num_samples, num_steps, uid, weight,
+            )
+            return (
+                ServerState(
+                    params=new_params,
+                    opt_state=new_opt_state,
+                    round_idx=new_round,
+                    base_key=state.base_key,
+                ),
+                metrics,
+            )
+
+        return round_step
+
+    def round_step(
+        self,
+        state: ServerState,
+        ds: ClientDataset,
+        participate: Optional[jax.Array] = None,
+        num_steps: Optional[jax.Array] = None,
+    ) -> Tuple[ServerState, RoundMetrics]:
+        """Advance one FL round over the (placed, padded) population.
+
+        ``participate`` — optional [C] 0/1 mask from the deviceflow trace
+        compiler; multiplies the base weights. ``num_steps`` — optional
+        per-client local-step counts (hetero compute profiles); defaults to
+        ``max_local_steps`` everywhere.
+        """
+        weight = ds.weight if participate is None else ds.weight * participate
+        if num_steps is None:
+            num_steps = jnp.full((ds.num_clients,), self.config.max_local_steps, jnp.int32)
+            num_steps = jax.device_put(num_steps, self.plan.client_sharding())
+        return self._round_step(
+            state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid, weight
+        )
+
+    # ----------------------------------------------------------------- eval
+    def _build_evaluate(self):
+        @jax.jit
+        def evaluate(params, x, y):
+            logits = self.apply_fn(params, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            acc = (logits.argmax(-1) == y).mean()
+            return loss, acc
+
+        return evaluate
+
+    def evaluate(self, params, x, y) -> Tuple[float, float]:
+        """Centralized eval of the global model, batched on device."""
+        bs = self.config.eval_batch_size
+        n = x.shape[0]
+        losses, accs, seen = [], [], 0
+        for i in range(0, n, bs):
+            xb, yb = x[i : i + bs], y[i : i + bs]
+            l, a = self._evaluate(params, jnp.asarray(xb), jnp.asarray(yb))
+            w = len(yb)
+            losses.append(float(l) * w)
+            accs.append(float(a) * w)
+            seen += w
+        return sum(losses) / seen, sum(accs) / seen
+
+
+def build_fedcore(
+    model_name: str,
+    algorithm: Algorithm,
+    plan: MeshPlan,
+    config: FedCoreConfig = FedCoreConfig(),
+    model_overrides: Optional[dict] = None,
+    input_shape: Optional[Tuple[int, ...]] = None,
+) -> FedCore:
+    """Convenience constructor from the model registry."""
+    from olearning_sim_tpu.models import get_model
+
+    spec = get_model(model_name)
+    model = spec.build(**(model_overrides or {}))
+    in_shape = input_shape or spec.example_input_shape
+
+    def apply_fn(params, x):
+        return model.apply({"params": params}, x)
+
+    def init_params_fn(rng):
+        dummy = jnp.zeros((1,) + in_shape, jnp.float32)
+        return model.init(rng, dummy)["params"]
+
+    return FedCore(apply_fn, init_params_fn, algorithm, plan, config)

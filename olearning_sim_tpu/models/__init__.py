@@ -1,0 +1,3 @@
+from olearning_sim_tpu.models.registry import ModelSpec, get_model, register_model
+
+__all__ = ["ModelSpec", "get_model", "register_model"]

@@ -1,0 +1,46 @@
+"""CNN family (BASELINE config 2 and the headline bench: 4-layer CNN on
+CIFAR-10, 10k clients at >=500 rounds/min on a v4-32)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from olearning_sim_tpu.models.registry import ModelSpec, register_model
+
+
+class CNN(nn.Module):
+    """4-layer CNN: two conv blocks + two dense layers, bfloat16 compute.
+
+    Convs and the dense layers are the MXU work; keeping them bf16 with fp32
+    logits matches TPU best practice and keeps the loss numerically stable.
+    """
+
+    features: Sequence[int] = (32, 64)
+    dense: int = 128
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(jnp.bfloat16)
+        for f in self.features:
+            x = nn.Conv(f, (3, 3), padding="SAME", dtype=jnp.bfloat16)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.dense, dtype=jnp.bfloat16)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+register_model(
+    ModelSpec(
+        name="cnn4",
+        builder=CNN,
+        example_input_shape=(32, 32, 3),
+        num_classes=10,
+        defaults={"features": (32, 64), "dense": 128, "num_classes": 10},
+    )
+)

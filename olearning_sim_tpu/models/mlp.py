@@ -1,0 +1,37 @@
+"""MLP family (BASELINE config 1: FedAvg, 2-layer MLP on MNIST, 100 IID clients)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from olearning_sim_tpu.models.registry import ModelSpec, register_model
+
+
+class MLP(nn.Module):
+    """Simple MLP classifier. Inputs are flattened; compute in bfloat16 so the
+    matmuls hit the MXU, params/outputs stay float32."""
+
+    hidden: Sequence[int] = (200,)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.bfloat16)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=jnp.bfloat16)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+register_model(
+    ModelSpec(
+        name="mlp2",
+        builder=MLP,
+        example_input_shape=(28, 28, 1),
+        num_classes=10,
+        defaults={"hidden": (200,), "num_classes": 10},
+    )
+)

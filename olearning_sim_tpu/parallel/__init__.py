@@ -1,0 +1,8 @@
+from olearning_sim_tpu.parallel.mesh import (
+    MeshPlan,
+    make_mesh_plan,
+    pad_to_multiple,
+    shard_clients,
+)
+
+__all__ = ["MeshPlan", "make_mesh_plan", "pad_to_multiple", "shard_clients"]

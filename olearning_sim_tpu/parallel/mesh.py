@@ -1,0 +1,102 @@
+"""Device-mesh construction and client-sharding plans.
+
+Replaces the reference's client->actor assignment
+(``ols_core/taskMgr/run_task.py:62-106`` ``construct_run_params``: split N
+virtual devices over M Ray actors and SPREAD placement groups) with a
+deterministic client->TPU-device sharding over a ``jax.sharding.Mesh``.
+
+Axis convention:
+
+- ``dp``  — the client/data axis. Virtual clients are sharded over it; FedAvg
+  weighted-delta reductions ride this axis as ``psum`` over ICI.
+- ``mp``  — model/tensor axis for sharding large model tensors (transformer
+  families); size 1 for the small device-class models.
+
+The plan is host-side metadata only; all device placement happens via
+``NamedSharding`` so XLA lays collectives on ICI, not DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A mesh plus the canonical shardings used by the engine."""
+
+    mesh: Mesh
+
+    @property
+    def dp(self) -> int:
+        return self.mesh.shape["dp"]
+
+    @property
+    def mp(self) -> int:
+        return self.mesh.shape["mp"]
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.mp
+
+    def client_sharding(self) -> NamedSharding:
+        """Arrays with a leading client axis: sharded over ``dp``."""
+        return NamedSharding(self.mesh, P("dp"))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def client_spec(self) -> P:
+        return P("dp")
+
+    def replicated_spec(self) -> P:
+        return P()
+
+
+def make_mesh_plan(
+    devices: Optional[Sequence[jax.Device]] = None,
+    dp: Optional[int] = None,
+    mp: int = 1,
+) -> MeshPlan:
+    """Build a ``(dp, mp)`` mesh over the given devices (default: all).
+
+    ``dp`` defaults to ``len(devices) // mp``. Device order follows
+    ``jax.devices()`` which is already topology-sorted for ICI adjacency.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if mp <= 0:
+        raise ValueError(f"mp must be positive, got {mp}")
+    if dp is None:
+        dp = len(devices) // mp
+    if dp * mp > len(devices):
+        raise ValueError(f"mesh {dp}x{mp} needs {dp * mp} devices, have {len(devices)}")
+    grid = np.asarray(devices[: dp * mp]).reshape(dp, mp)
+    return MeshPlan(mesh=Mesh(grid, ("dp", "mp")))
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Smallest m >= n with m % multiple == 0 (and m >= multiple)."""
+    if multiple <= 0:
+        raise ValueError(f"multiple must be positive, got {multiple}")
+    return max(multiple, int(math.ceil(n / multiple)) * multiple)
+
+
+def shard_clients(num_clients: int, plan: MeshPlan, block: int = 1) -> tuple[int, int]:
+    """Deterministic client->device split (the ``construct_run_params`` analogue).
+
+    Returns ``(padded_clients, clients_per_device)`` where padding makes the
+    client axis divisible by ``dp * block`` so each device holds an integer
+    number of vmap blocks. Padded clients carry zero aggregation weight, so
+    they never perturb results (the reference instead assigns remainders to
+    the last actor, ``run_task.py:84-106``).
+    """
+    padded = pad_to_multiple(num_clients, plan.dp * block)
+    return padded, padded // plan.dp

@@ -1,0 +1,20 @@
+"""Test config: run everything on an 8-device virtual CPU mesh.
+
+Mirrors SURVEY.md section 4's test-pyramid plan: pmap/pjit semantics are
+exercised on CPU with ``--xla_force_host_platform_device_count`` so multi-chip
+sharding is validated without TPU hardware. The sandbox pins
+``JAX_PLATFORMS`` via sitecustomize, so the env var alone is not enough —
+``jax.config.update`` after import wins. Must run before any backend
+initialization, hence at conftest import time.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

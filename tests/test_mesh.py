@@ -1,0 +1,35 @@
+import jax
+import pytest
+
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan, pad_to_multiple, shard_clients
+
+
+def test_pad_to_multiple():
+    assert pad_to_multiple(100, 8) == 104
+    assert pad_to_multiple(8, 8) == 8
+    assert pad_to_multiple(1, 8) == 8
+    assert pad_to_multiple(0, 8) == 8
+    with pytest.raises(ValueError):
+        pad_to_multiple(4, 0)
+
+
+def test_mesh_plan_shapes():
+    plan = make_mesh_plan()
+    assert plan.n_devices == len(jax.devices())
+    assert plan.mp == 1
+
+    plan42 = make_mesh_plan(dp=4, mp=2)
+    assert plan42.dp == 4 and plan42.mp == 2
+
+
+def test_mesh_plan_too_many_devices():
+    with pytest.raises(ValueError):
+        make_mesh_plan(dp=1000, mp=1000)
+
+
+def test_shard_clients_padding():
+    plan = make_mesh_plan(dp=8, mp=1)
+    padded, per_dev = shard_clients(100, plan, block=4)
+    assert padded % (8 * 4) == 0
+    assert padded >= 100
+    assert per_dev * 8 == padded
