@@ -1,0 +1,37 @@
+from olearning_sim_tpu.deviceflow.strategy import (
+    DispatchSchedule,
+    RealTimePlan,
+    analyze_flow_strategy,
+    analyze_real_time_strategy,
+    is_real_time_dispatch,
+)
+from olearning_sim_tpu.deviceflow.validate import check_notify_start_params, check_strategy
+from olearning_sim_tpu.deviceflow.trace_compiler import ClientTrace, compile_trace
+from olearning_sim_tpu.deviceflow.dispatcher import Clock, Dispatcher, VirtualClock
+from olearning_sim_tpu.deviceflow.flow import FlowManager
+from olearning_sim_tpu.deviceflow.registry import TaskRegistry
+from olearning_sim_tpu.deviceflow.rooms import InboundRoom, Message, ShelfRoom
+from olearning_sim_tpu.deviceflow.service import DeviceFlowService
+from olearning_sim_tpu.deviceflow.sorter import Sorter
+
+__all__ = [
+    "ClientTrace",
+    "Clock",
+    "DeviceFlowService",
+    "Dispatcher",
+    "DispatchSchedule",
+    "FlowManager",
+    "InboundRoom",
+    "Message",
+    "RealTimePlan",
+    "ShelfRoom",
+    "Sorter",
+    "TaskRegistry",
+    "VirtualClock",
+    "analyze_flow_strategy",
+    "analyze_real_time_strategy",
+    "check_notify_start_params",
+    "check_strategy",
+    "compile_trace",
+    "is_real_time_dispatch",
+]

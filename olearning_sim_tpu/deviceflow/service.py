@@ -1,0 +1,284 @@
+"""DeviceFlow service: the stateful gradient-house orchestrator.
+
+Reference: ``ols_core/deviceflow/grpc_service/deviceflow_server.py:43-473`` —
+a stateful server with three daemon threads (sort / dispatch / flow-release),
+per-flow lifecycle Register -> NotifyStart -> (messages flow) ->
+NotifyComplete -> dispatch -> release, crash recovery from its table, and a
+``CheckDeviceflowDispatchFinished`` RPC that gates task teardown in the task
+manager (``task_manager.py:1104-1121``).
+
+This class is transport-agnostic: the gRPC surface wraps these methods 1:1,
+and in single-process mode the engine calls them directly. Messages enter via
+:meth:`publish` (the reference's Pulsar inbound topic).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from olearning_sim_tpu.deviceflow.dispatcher import Clock, Dispatcher
+from olearning_sim_tpu.deviceflow.flow import FlowManager
+from olearning_sim_tpu.deviceflow.registry import TaskRegistry
+from olearning_sim_tpu.deviceflow.rooms import InboundRoom, Message, ShelfRoom
+from olearning_sim_tpu.deviceflow.sorter import Sorter
+from olearning_sim_tpu.deviceflow.validate import check_notify_start_params
+from olearning_sim_tpu.utils.logging import Logger
+from olearning_sim_tpu.utils.repo import TableRepo
+
+
+class DeviceFlowService:
+    def __init__(
+        self,
+        flow_repo: Optional[TableRepo] = None,
+        registry_repo: Optional[TableRepo] = None,
+        outbound_factory: Optional[Callable[[str, Dict[str, Any]], Callable[[List[Any]], None]]] = None,
+        clock: Optional[Clock] = None,
+        logger: Optional[Logger] = None,
+        poll_interval: float = 0.05,
+        seed: int = 0,
+    ):
+        self.logger = logger if logger is not None else Logger()
+        self.flow_manager = FlowManager(repo=flow_repo, logger=self.logger)
+        self.registry = TaskRegistry(repo=registry_repo, logger=self.logger)
+        self.inbound = InboundRoom()
+        self.shelf_room = ShelfRoom()
+        self.sorter = Sorter(self.shelf_room)
+        self.clock = clock if clock is not None else Clock()
+        self.poll_interval = poll_interval
+        self.seed = seed
+        # outbound_factory(flow_id, outbound_service_cfg) -> producer callable.
+        # Default: collect delivered batches in-memory per flow for inspection.
+        self.delivered: Dict[str, List[Any]] = {}
+        self._outbound_factory = outbound_factory or self._default_outbound
+
+        self._lock = threading.RLock()
+        self.flow: Dict[str, Dict[str, Any]] = self.flow_manager.load_flows()
+        self._dispatchers: Dict[str, Dispatcher] = {}
+        # Daemon threads, not a ThreadPoolExecutor: a dispatcher whose flow is
+        # never completed must not block interpreter shutdown.
+        self._dispatch_threads: Dict[str, threading.Thread] = {}
+        self._dispatch_done: Dict[str, bool] = {}  # clean completion flag
+        self._dispatch_failed: set = set()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # Watermark for the publish/notify_complete handshake: every message
+        # enqueued before a notify_complete snapshot must be *sorted* (not
+        # merely dequeued) before completion is recorded.
+        self._enqueued_count = 0
+        self._sorted_count = 0
+
+    def _default_outbound(self, flow_id: str, cfg: Dict[str, Any]):
+        def producer(batch: List[Any]):
+            self.delivered.setdefault(flow_id, []).extend(batch)
+
+        return producer
+
+    # ----------------------------------------------------------------- RPCs
+    def register_task(self, task_id: str, total_compute_resources: List[str]) -> bool:
+        return self.registry.register_task(task_id, total_compute_resources)
+
+    def unregister_task(self, task_id: str) -> bool:
+        return self.registry.unregister_task(task_id)
+
+    def notify_start(
+        self,
+        task_id: str,
+        routing_key: str,
+        compute_resource: str,
+        strategy: str,
+        outbound_service: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[bool, str]:
+        """Reference ``NotifyStart`` (``deviceflow_server.py:166-260``):
+        validate, create/join the flow, start sorting; when every registered
+        resource has started, the dispatcher is armed."""
+        if not self.registry.is_registered(task_id):
+            return False, f"task {task_id} not registered"
+        ok, msg = check_notify_start_params(compute_resource, strategy)
+        if not ok:
+            return False, msg
+        with self._lock:
+            ok, params = self.flow_manager.notify_start(
+                self.flow, task_id, routing_key, compute_resource, strategy,
+                outbound_service,
+            )
+            if not ok:
+                return False, "notify_start failed"
+            self.flow[routing_key] = params
+            self.shelf_room.add_shelf(routing_key)
+            params["to_sort"] = True
+            if self.flow_manager.check_all_notify_start(
+                self.registry.get(task_id), params
+            ):
+                params["to_dispatch"] = True
+            # Re-persist with the sort/dispatch flags so crash recovery
+            # re-arms dispatchers (reference deviceflow_server.py:137-164).
+            self.flow_manager.persist(routing_key, task_id, params)
+        return True, "Pass"
+
+    def notify_complete(
+        self, task_id: str, routing_key: str, compute_resource: str,
+        flush_timeout: float = 30.0,
+    ) -> Tuple[bool, str]:
+        # Drain in-flight inbound messages first: updates published before
+        # NotifyComplete must not be discarded just because the sort loop
+        # hasn't consumed them yet. (The reference has this same race over
+        # Pulsar, ``sorter.py:56-69``; in-process we close it with a sorted-
+        # count watermark: completion is recorded only after every message
+        # enqueued before this call has actually been sorted.)
+        with self._lock:
+            watermark = self._enqueued_count
+        deadline = time.monotonic() + flush_timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._sorted_count >= watermark:
+                    break
+            time.sleep(min(self.poll_interval, 0.01))
+        with self._lock:
+            ok, params = self.flow_manager.notify_complete(
+                self.flow, task_id, routing_key, compute_resource
+            )
+            if not ok:
+                return False, "notify_complete failed"
+            self.flow[routing_key] = params
+            if self.flow_manager.check_all_notify_complete(
+                self.registry.get(task_id), params
+            ):
+                disp = self._dispatchers.get(routing_key)
+                if disp is not None:
+                    disp.release_dispatch()
+        return True, "Pass"
+
+    def publish(self, routing_key: str, compute_resource: str, payload: Any) -> None:
+        """Client updates enter here (the Pulsar inbound topic analogue)."""
+        with self._lock:
+            self._enqueued_count += 1
+        self.inbound.put(Message(routing_key, compute_resource, payload))
+
+    def check_dispatch_finished(self, task_id: str) -> bool:
+        """Reference ``CheckDeviceflowDispatchFinished``
+        (``deviceflow_server.py:403-427``): True when no unfinished flow of
+        this task remains."""
+        with self._lock:
+            for params in self.flow.values():
+                if params["task_id"] == task_id and not params.get("isFinished", False):
+                    return False
+            return True
+
+    # -------------------------------------------------------------- threads
+    def start(self) -> None:
+        """Start the three daemon loops (reference ``deviceflow_server.py:76-81``)."""
+        self._stop.clear()
+        for target, name in (
+            (self._sort_loop, "deviceflow-sort"),
+            (self._dispatch_loop, "deviceflow-dispatch"),
+            (self._release_loop, "deviceflow-release"),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            for disp in self._dispatchers.values():
+                disp.release_dispatch()  # let open-flow dispatch loops exit
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    def _sort_loop(self) -> None:
+        while not self._stop.is_set():
+            msg = self.inbound.get(timeout=self.poll_interval)
+            if msg is None:
+                continue
+            with self._lock:
+                self.sorter.sort(self.flow, msg)
+                self._sorted_count += 1
+
+    def _dispatch_loop(self) -> None:
+        """Arm a dispatcher for every flow whose resources all started
+        (reference ``deviceflow_server.py:429-451``)."""
+        while not self._stop.is_set():
+            with self._lock:
+                for flow_id, params in list(self.flow.items()):
+                    if not params.get("to_dispatch") or flow_id in self._dispatchers:
+                        continue
+                    disp = Dispatcher(
+                        flow_id=flow_id,
+                        strategy=params["strategy"],
+                        shelf_room=self.shelf_room,
+                        producer=self._outbound_factory(
+                            flow_id, params.get("outbound_service", {})
+                        ),
+                        clock=self.clock,
+                        # crc32 keeps per-flow streams stable across processes
+                        # (hash() is salted by PYTHONHASHSEED).
+                        rng=np.random.default_rng(
+                            [self.seed, zlib.crc32(flow_id.encode())]
+                        ),
+                        poll_interval=self.poll_interval,
+                    )
+                    self._dispatchers[flow_id] = disp
+                    if self.flow_manager.check_all_notify_complete(
+                        self.registry.get(params["task_id"]), params
+                    ):
+                        disp.release_dispatch()
+                    self._dispatch_done[flow_id] = False
+                    t = threading.Thread(
+                        target=self._run_dispatch,
+                        args=(flow_id, disp),
+                        name=f"dispatch-{flow_id}",
+                        daemon=True,
+                    )
+                    t.start()
+                    self._dispatch_threads[flow_id] = t
+            self._stop.wait(self.poll_interval)
+
+    def _run_dispatch(self, flow_id: str, disp: Dispatcher) -> None:
+        try:
+            disp.dispatch()
+            with self._lock:
+                self._dispatch_done[flow_id] = True
+        except Exception as e:  # noqa: BLE001 — surfaced via log + open flow
+            params = self.flow.get(flow_id, {})
+            self.logger.error(
+                task_id=params.get("task_id", ""),
+                system_name="Deviceflow",
+                module_name="dispatch",
+                message=f"dispatcher for flow {flow_id} crashed: {e!r}; "
+                f"flow left open (staged messages preserved)",
+            )
+
+    def _release_loop(self) -> None:
+        """Mark flows finished once dispatch drained; persist and drop state
+        (reference ``deviceflow_server.py:453-473``). A crashed dispatcher
+        does NOT finish its flow: the failure is logged, staged messages stay
+        on the shelf, and check_dispatch_finished keeps returning False so the
+        task manager sees the stall instead of a silent success."""
+        while not self._stop.is_set():
+            with self._lock:
+                for flow_id, t in list(self._dispatch_threads.items()):
+                    if t.is_alive():
+                        continue
+                    if not self._dispatch_done.get(flow_id, False):
+                        if flow_id not in self._dispatch_failed:
+                            self._dispatch_failed.add(flow_id)
+                        del self._dispatch_threads[flow_id]  # no re-arm
+                        continue
+                    params = self.flow.get(flow_id)
+                    if params is None:
+                        continue
+                    params["isFinished"] = True
+                    self.flow_manager.persist(flow_id, params["task_id"], params)
+                    self.flow_manager.release_flow(flow_id)
+                    self.shelf_room.close_shelf(flow_id)
+                    del self._dispatch_threads[flow_id]
+                    del self._dispatchers[flow_id]
+                    del self.flow[flow_id]
+                    self._dispatch_done.pop(flow_id, None)
+            self._stop.wait(self.poll_interval)

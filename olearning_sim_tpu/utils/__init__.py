@@ -1,0 +1,4 @@
+from olearning_sim_tpu.utils.repo import MemoryTableRepo, SqliteTableRepo, TableRepo
+from olearning_sim_tpu.utils.logging import Logger
+
+__all__ = ["Logger", "MemoryTableRepo", "SqliteTableRepo", "TableRepo"]

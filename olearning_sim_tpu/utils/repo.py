@@ -1,0 +1,212 @@
+"""State repositories.
+
+The reference persists every piece of control-plane state in MySQL via a
+generic table accessor (``ols_core/utils/repo_utils.py:19-400`` SqlDataBase,
+specialized as TaskTableRepo / ResTableRepo / the deviceflow table). The
+rebuild keeps the same narrow interface but behind an ABC with two default
+implementations:
+
+- :class:`MemoryTableRepo` — dict-backed, for single-process mode and tests;
+- :class:`SqliteTableRepo` — stdlib sqlite3 file DB for durable single-host
+  deployments (crash recovery semantics, SURVEY.md section 5); a MySQL-backed
+  implementation can slot in behind the same interface for cluster mode.
+
+All values are stored as TEXT (the reference serializes JSON into MySQL text
+columns the same way); typed access is the caller's concern.
+"""
+
+from __future__ import annotations
+
+import abc
+import sqlite3
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class TableRepo(abc.ABC):
+    """Narrow table interface shared by all control-plane state."""
+
+    @abc.abstractmethod
+    def add_item(self, item: Dict[str, List[Any]]) -> bool:
+        """Insert rows given a column->values mapping (reference
+        ``SqlDataBase.add_item`` signature)."""
+
+    @abc.abstractmethod
+    def get_item_value(self, identify_name: str, identify_value: Any, item: str) -> Optional[Any]:
+        """Value of column ``item`` for the first row where
+        ``identify_name == identify_value``."""
+
+    @abc.abstractmethod
+    def set_item_value(self, identify_name: str, identify_value: Any, item: str, value: Any) -> bool:
+        """Set column ``item`` on all rows matching the identifier."""
+
+    @abc.abstractmethod
+    def delete_items(self, **conditions: Any) -> bool:
+        """Delete all rows matching the conditions."""
+
+    @abc.abstractmethod
+    def get_values_by_conditions(self, item: str, **conditions: Any) -> List[Any]:
+        """All values of column ``item`` over rows matching the conditions."""
+
+    @abc.abstractmethod
+    def query_all(self) -> List[Dict[str, Any]]:
+        """Every row as a dict."""
+
+    # Convenience shared helpers -------------------------------------------------
+    def has_item(self, identify_name: str, identify_value: Any) -> bool:
+        return len(self.get_values_by_conditions(identify_name, **{identify_name: identify_value})) > 0
+
+
+class MemoryTableRepo(TableRepo):
+    def __init__(self, columns: Sequence[str]):
+        self.columns = list(columns)
+        self._rows: List[Dict[str, Any]] = []
+        self._lock = threading.RLock()
+
+    def add_item(self, item: Dict[str, List[Any]]) -> bool:
+        with self._lock:
+            lengths = {len(v) for v in item.values()}
+            if len(lengths) > 1:
+                return False
+            n = lengths.pop() if lengths else 0
+            for i in range(n):
+                row = {c: None for c in self.columns}
+                for k, vals in item.items():
+                    if k not in self.columns:
+                        return False
+                    row[k] = vals[i]
+                self._rows.append(row)
+            return True
+
+    def get_item_value(self, identify_name, identify_value, item):
+        with self._lock:
+            for row in self._rows:
+                if row.get(identify_name) == identify_value:
+                    return row.get(item)
+            return None
+
+    def set_item_value(self, identify_name, identify_value, item, value) -> bool:
+        with self._lock:
+            if item not in self.columns:
+                return False
+            hit = False
+            for row in self._rows:
+                if row.get(identify_name) == identify_value:
+                    row[item] = value
+                    hit = True
+            return hit
+
+    def delete_items(self, **conditions) -> bool:
+        with self._lock:
+            before = len(self._rows)
+            self._rows = [
+                r for r in self._rows
+                if not all(r.get(k) == v for k, v in conditions.items())
+            ]
+            return len(self._rows) < before
+
+    def get_values_by_conditions(self, item, **conditions):
+        with self._lock:
+            return [
+                r.get(item) for r in self._rows
+                if all(r.get(k) == v for k, v in conditions.items())
+            ]
+
+    def query_all(self):
+        with self._lock:
+            return [dict(r) for r in self._rows]
+
+
+class SqliteTableRepo(TableRepo):
+    """sqlite3-backed repo; one table per instance, TEXT columns.
+
+    check_same_thread=False + a process lock gives the same
+    many-threads/one-writer discipline the reference relies on (its services
+    share one SqlDataBase handle across daemon threads).
+    """
+
+    def __init__(self, path: str, table: str, columns: Sequence[str]):
+        if not table.isidentifier():
+            raise ValueError(f"invalid table name {table!r}")
+        for c in columns:
+            if not c.isidentifier():
+                raise ValueError(f"invalid column name {c!r}")
+        self.table = table
+        self.columns = list(columns)
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        cols = ", ".join(f"{c} TEXT" for c in self.columns)
+        with self._lock:
+            self._conn.execute(f"CREATE TABLE IF NOT EXISTS {table} ({cols})")
+            self._conn.commit()
+
+    def _col(self, name: str) -> str:
+        if name not in self.columns:
+            raise KeyError(f"unknown column {name!r} for table {self.table}")
+        return name
+
+    def add_item(self, item: Dict[str, List[Any]]) -> bool:
+        try:
+            keys = [self._col(k) for k in item]
+            lengths = {len(v) for v in item.values()}
+            if len(lengths) > 1:
+                return False
+            n = lengths.pop() if lengths else 0
+            placeholders = ", ".join("?" for _ in keys)
+            sql = f"INSERT INTO {self.table} ({', '.join(keys)}) VALUES ({placeholders})"
+            with self._lock:
+                for i in range(n):
+                    self._conn.execute(sql, [item[k][i] for k in keys])
+                self._conn.commit()
+            return True
+        except (sqlite3.Error, KeyError):
+            return False
+
+    def get_item_value(self, identify_name, identify_value, item):
+        sql = (
+            f"SELECT {self._col(item)} FROM {self.table} "
+            f"WHERE {self._col(identify_name)} = ? LIMIT 1"
+        )
+        with self._lock:
+            cur = self._conn.execute(sql, (identify_value,))
+            row = cur.fetchone()
+        return row[0] if row else None
+
+    def set_item_value(self, identify_name, identify_value, item, value) -> bool:
+        try:
+            sql = (
+                f"UPDATE {self.table} SET {self._col(item)} = ? "
+                f"WHERE {self._col(identify_name)} = ?"
+            )
+            with self._lock:
+                cur = self._conn.execute(sql, (value, identify_value))
+                self._conn.commit()
+            return cur.rowcount > 0
+        except sqlite3.Error:
+            return False
+
+    def delete_items(self, **conditions) -> bool:
+        try:
+            clause = " AND ".join(f"{self._col(k)} = ?" for k in conditions)
+            sql = f"DELETE FROM {self.table}" + (f" WHERE {clause}" if clause else "")
+            with self._lock:
+                cur = self._conn.execute(sql, list(conditions.values()))
+                self._conn.commit()
+            return cur.rowcount > 0
+        except sqlite3.Error:
+            return False
+
+    def get_values_by_conditions(self, item, **conditions):
+        clause = " AND ".join(f"{self._col(k)} = ?" for k in conditions)
+        sql = f"SELECT {self._col(item)} FROM {self.table}" + (
+            f" WHERE {clause}" if clause else ""
+        )
+        with self._lock:
+            cur = self._conn.execute(sql, list(conditions.values()))
+            return [r[0] for r in cur.fetchall()]
+
+    def query_all(self):
+        with self._lock:
+            cur = self._conn.execute(f"SELECT {', '.join(self.columns)} FROM {self.table}")
+            rows = cur.fetchall()
+        return [dict(zip(self.columns, r)) for r in rows]

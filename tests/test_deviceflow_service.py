@@ -1,0 +1,218 @@
+"""Flow lifecycle end-to-end: Register -> NotifyStart -> messages ->
+NotifyComplete -> dispatch -> release (reference
+``deviceflow_server.py:166-473`` semantics, in-process transport)."""
+
+import json
+import time
+
+import pytest
+
+from olearning_sim_tpu.deviceflow import (
+    DeviceFlowService,
+    FlowManager,
+    Message,
+    ShelfRoom,
+    Sorter,
+    TaskRegistry,
+    VirtualClock,
+)
+from olearning_sim_tpu.deviceflow.dispatcher import Dispatcher
+from olearning_sim_tpu.utils.repo import MemoryTableRepo
+from olearning_sim_tpu.deviceflow.flow import FLOW_COLUMNS
+
+
+def rt_strategy():
+    return json.dumps({
+        "real_time_dispatch": {"use_strategy": True, "dispatch_batch_sizes": [5]}
+    })
+
+
+def flow_strategy(total=20, timings=(0, 1), amounts=(10, 10)):
+    return json.dumps({
+        "flow_dispatch": {
+            "use_strategy": True,
+            "total_dispatch_amount": total,
+            "specific_timing": {
+                "use": True,
+                "time_type": "relative",
+                "timings": list(timings),
+                "amounts": list(amounts),
+            },
+        }
+    })
+
+
+def wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_flow_manager_lifecycle_and_consistency():
+    fm = FlowManager()
+    flow = {}
+    ok, params = fm.notify_start(flow, "t1", "t1_op_0", "logical_simulation", "s", {})
+    assert ok
+    flow["t1_op_0"] = params
+    # second resource with mismatched strategy -> rejected
+    ok, _ = fm.notify_start(flow, "t1", "t1_op_0", "device_simulation", "DIFFERENT", {})
+    assert not ok
+    ok, params = fm.notify_start(flow, "t1", "t1_op_0", "device_simulation", "s", {})
+    assert ok
+    reg = {"total_compute_resources": ["logical_simulation", "device_simulation"]}
+    assert fm.check_all_notify_start(reg, params)
+    assert not fm.check_all_notify_complete(reg, params)
+    ok, params = fm.notify_complete(flow, "t1", "t1_op_0", "logical_simulation")
+    assert ok
+    assert not fm.check_all_notify_complete(reg, params)
+    ok, params = fm.notify_complete(flow, "t1", "t1_op_0", "device_simulation")
+    assert fm.check_all_notify_complete(reg, params)
+    # unknown flow -> error (deviceflow.py:145-146)
+    assert not fm.notify_complete(flow, "t1", "missing", "logical_simulation")[0]
+
+
+def test_flow_recovery_from_repo():
+    repo = MemoryTableRepo(FLOW_COLUMNS)
+    fm = FlowManager(repo=repo)
+    flow = {}
+    ok, params = fm.notify_start(flow, "t1", "t1_op_0", "logical_simulation", "s", {})
+    assert ok
+    # a fresh manager over the same repo sees the unfinished flow
+    fm2 = FlowManager(repo=repo)
+    recovered = fm2.load_flows()
+    assert "t1_op_0" in recovered
+    assert recovered["t1_op_0"]["notify_start_called"] == {"logical_simulation": True}
+
+
+def test_sorter_gates_on_lifecycle():
+    shelf = ShelfRoom()
+    sorter = Sorter(shelf)
+    flow = {}
+    msg = Message("t1_op_0", "logical_simulation", b"g1")
+    assert not sorter.sort(flow, msg)  # before start: discarded
+    flow["t1_op_0"] = {
+        "notify_start_called": {"logical_simulation": True},
+        "notify_complete_called": {},
+    }
+    assert sorter.sort(flow, msg)
+    flow["t1_op_0"]["notify_complete_called"]["logical_simulation"] = True
+    assert not sorter.sort(flow, msg)  # after complete: discarded
+    assert shelf.shelf_size("t1_op_0") == 1
+
+
+def test_dispatcher_flow_schedule_virtual_time():
+    shelf = ShelfRoom()
+    shelf.add_shelf("f")
+    for i in range(20):
+        shelf.put_on_shelf("f", i)
+    delivered = []
+    clock = VirtualClock()
+    disp = Dispatcher("f", flow_strategy(), shelf, delivered.extend, clock=clock)
+    disp.release_dispatch()
+    disp.dispatch()
+    assert len(delivered) == 20
+    assert clock.now() >= 1.0  # both schedule slots executed in virtual time
+
+
+def test_service_end_to_end_real_time():
+    svc = DeviceFlowService(poll_interval=0.01)
+    svc.start()
+    try:
+        assert svc.register_task("t1", ["logical_simulation"])
+        ok, msg = svc.notify_start("t1", "t1_op_0", "logical_simulation", rt_strategy())
+        assert ok, msg
+        for i in range(17):
+            svc.publish("t1_op_0", "logical_simulation", f"update-{i}")
+        assert wait_until(lambda: svc.sorter.accepted == 17)
+        ok, _ = svc.notify_complete("t1", "t1_op_0", "logical_simulation")
+        assert ok
+        assert wait_until(lambda: svc.check_dispatch_finished("t1"))
+        assert len(svc.delivered.get("t1_op_0", [])) == 17
+        assert svc.delivered["t1_op_0"][0] == "update-0"
+    finally:
+        svc.stop()
+
+
+def test_service_rejects_unregistered_and_bad_strategy():
+    svc = DeviceFlowService(poll_interval=0.01)
+    ok, msg = svc.notify_start("ghost", "ghost_op_0", "logical_simulation", rt_strategy())
+    assert not ok and "not registered" in msg
+    svc.register_task("t1", ["logical_simulation"])
+    ok, msg = svc.notify_start("t1", "t1_op_0", "logical_simulation", "not-json{")
+    assert not ok and msg == "strategy not json format"
+
+
+def test_service_two_resources_flow_mode():
+    svc = DeviceFlowService(poll_interval=0.01, clock=VirtualClock())
+    svc.start()
+    try:
+        svc.register_task("t2", ["logical_simulation", "device_simulation"])
+        strat = flow_strategy(total=10, timings=[0], amounts=[10])
+        ok, _ = svc.notify_start("t2", "t2_op_0", "logical_simulation", strat)
+        assert ok
+        # only one of two resources started -> dispatch must not finish yet
+        for i in range(6):
+            svc.publish("t2_op_0", "logical_simulation", i)
+        assert wait_until(lambda: svc.sorter.accepted == 6)
+        assert not svc.check_dispatch_finished("t2")
+        ok, _ = svc.notify_start("t2", "t2_op_0", "device_simulation", strat)
+        assert ok
+        for i in range(4):
+            svc.publish("t2_op_0", "device_simulation", 100 + i)
+        assert wait_until(lambda: svc.sorter.accepted == 10)
+        svc.notify_complete("t2", "t2_op_0", "logical_simulation")
+        assert not svc.check_dispatch_finished("t2")
+        svc.notify_complete("t2", "t2_op_0", "device_simulation")
+        assert wait_until(lambda: svc.check_dispatch_finished("t2"))
+        assert len(svc.delivered["t2_op_0"]) == 10
+    finally:
+        svc.stop()
+
+
+def test_crash_recovery_rearms_dispatch():
+    """A flow fully started before a crash must dispatch after restart
+    (to_dispatch flag is persisted; reference deviceflow_server.py:83-164)."""
+    repo = MemoryTableRepo(FLOW_COLUMNS)
+    from olearning_sim_tpu.deviceflow.registry import REGISTRY_COLUMNS
+    reg_repo = MemoryTableRepo(REGISTRY_COLUMNS)
+    svc = DeviceFlowService(flow_repo=repo, registry_repo=reg_repo, poll_interval=0.01)
+    svc.register_task("tR", ["logical_simulation"])
+    ok, _ = svc.notify_start("tR", "tR_op_0", "logical_simulation", rt_strategy())
+    assert ok
+    # "crash": no threads were running; a new service recovers from the repo
+    svc2 = DeviceFlowService(flow_repo=repo, registry_repo=reg_repo, poll_interval=0.01)
+    assert "tR_op_0" in svc2.flow
+    assert svc2.flow["tR_op_0"]["to_dispatch"] is True
+    svc2.start()
+    try:
+        for i in range(5):
+            svc2.publish("tR_op_0", "logical_simulation", i)
+        svc2.notify_complete("tR", "tR_op_0", "logical_simulation")
+        assert wait_until(lambda: svc2.check_dispatch_finished("tR"))
+        assert len(svc2.delivered["tR_op_0"]) == 5
+    finally:
+        svc2.stop()
+
+
+def test_crashed_dispatcher_leaves_flow_open():
+    """Outbound failure must not silently finish the flow (messages kept)."""
+    def bad_outbound(flow_id, cfg):
+        def producer(batch):
+            raise RuntimeError("outbound endpoint down")
+        return producer
+
+    svc = DeviceFlowService(poll_interval=0.01, outbound_factory=bad_outbound)
+    svc.start()
+    try:
+        svc.register_task("tX", ["logical_simulation"])
+        svc.notify_start("tX", "tX_op_0", "logical_simulation", rt_strategy())
+        for i in range(12):
+            svc.publish("tX_op_0", "logical_simulation", i)
+        svc.notify_complete("tX", "tX_op_0", "logical_simulation")
+        time.sleep(0.5)
+        assert not svc.check_dispatch_finished("tX")  # stall visible, not silent success
+    finally:
+        svc.stop()

@@ -1,0 +1,238 @@
+"""Schedule-synthesis semantics vs the reference grammar
+(``ols_core/deviceflow/non_grpc/strategy.py``)."""
+
+import json
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.deviceflow.strategy import (
+    analyze_flow_strategy,
+    analyze_real_time_strategy,
+    is_real_time_dispatch,
+)
+
+
+def flow(spec):
+    return {"flow_dispatch": {"use_strategy": True, **spec}}
+
+
+RNG = lambda: np.random.default_rng(0)
+
+
+def test_real_time_detection_and_params():
+    s = {
+        "real_time_dispatch": {
+            "use_strategy": True,
+            "dispatch_batch_sizes": [10, 20],
+            "drop_simulation": {"drop_probability": 0.25},
+        }
+    }
+    assert is_real_time_dispatch(s)
+    plan = analyze_real_time_strategy(s)
+    assert plan.batch_sizes == [10, 20]
+    assert plan.drop_probability == 0.25
+    assert not is_real_time_dispatch(flow({}))
+
+
+def test_disabled_or_malformed_gives_empty():
+    assert analyze_flow_strategy({"flow_dispatch": {"use_strategy": False}}, "t_op_0").empty
+    assert analyze_flow_strategy(flow({"total_dispatch_amount": 0}), "t_op_0").empty
+    # both timing and interval set -> empty (strategy.py:48-49)
+    both = flow({
+        "total_dispatch_amount": 10,
+        "specific_timing": {"use": True},
+        "specific_interval": {"use": True},
+    })
+    assert analyze_flow_strategy(both, "t_op_0").empty
+
+
+def test_specific_timing_relative():
+    s = flow({
+        "total_dispatch_amount": 60,
+        "specific_timing": {
+            "use": True,
+            "time_type": "relative",
+            "timings": [0, 5, 10],
+            "amounts": [10, 20, 30],
+        },
+    })
+    sched = analyze_flow_strategy(s, "t_op_0", rng=RNG())
+    assert sched.timings == [0.0, 5.0, 10.0]
+    assert sched.amounts == [10, 20, 30]
+    assert sched.total_sent == 60
+    assert sched.total_dropped == 0
+    assert sched.absolute_times() == [0.0, 5.0, 15.0]
+
+
+def test_specific_timing_absolute_rounds_and_past_filtering():
+    # Round 1 of a multi-round absolute schedule; first time point is in the
+    # past relative to `now` and must be filtered (strategy.py:136-158).
+    s = flow({
+        "total_dispatch_amount": 30,
+        "specific_timing": {
+            "use": True,
+            "time_type": "absolute",
+            "time_zone": "UTC",
+            "timings": [
+                ["2026-01-01 00:00:01", "2026-01-01 00:00:02"],
+                ["2026-01-01 00:00:00", "2026-01-01 00:01:00", "2026-01-01 00:02:00"],
+            ],
+            "amounts": [10, 20],
+        },
+    })
+    # round 1 has 3 timings but only 2 amounts -> empty (len mismatch)
+    now = datetime(2026, 1, 1, 0, 0, 30)
+    assert analyze_flow_strategy(s, "t_op_1", rng=RNG(), now=now).empty
+
+    s["flow_dispatch"]["specific_timing"]["timings"][1] = [
+        "2026-01-01 00:00:00",
+        "2026-01-01 00:01:00",
+    ]
+    sched = analyze_flow_strategy(s, "t_op_1", rng=RNG(), now=now)
+    # the 00:00:00 point is 30s in the past -> dropped along with its amount
+    assert sched.amounts == [20]
+    assert sched.timings == [30.0]
+
+
+def test_timing_drop_probability_extremes_and_determinism():
+    base = {
+        "total_dispatch_amount": 40,
+        "specific_timing": {
+            "use": True,
+            "time_type": "relative",
+            "timings": [0, 1],
+            "amounts": [20, 20],
+            "drop_simulation": {"drop_probability": [0.0, 1.0]},
+        },
+    }
+    sched = analyze_flow_strategy(flow(base), "t_op_0", rng=RNG())
+    assert sched.drop_lists[0] == []
+    assert sched.drop_lists[1] == list(range(20))
+
+    base["specific_timing"]["drop_simulation"] = {"drop_probability": [0.5, 0.5]}
+    a = analyze_flow_strategy(flow(base), "t_op_0", rng=np.random.default_rng(42))
+    b = analyze_flow_strategy(flow(base), "t_op_0", rng=np.random.default_rng(42))
+    assert a.drop_lists == b.drop_lists
+
+
+def test_timing_drop_amounts():
+    s = flow({
+        "total_dispatch_amount": 30,
+        "specific_timing": {
+            "use": True,
+            "time_type": "relative",
+            "timings": [0, 1],
+            "amounts": [10, 20],
+            "drop_simulation": {"drop_amounts": [3, 20]},
+        },
+    })
+    sched = analyze_flow_strategy(s, "t_op_0", rng=RNG())
+    assert len(sched.drop_lists[0]) == 3
+    assert sched.drop_lists[0] == sorted(sched.drop_lists[0])
+    # drop_amount >= amount drops everything (strategy.py:303-307)
+    assert sched.drop_lists[1] == list(range(20))
+    # both drop mechanisms at once -> empty schedule (strategy.py:101-102)
+    s["flow_dispatch"]["specific_timing"]["drop_simulation"] = {
+        "drop_probability": [0, 0],
+        "drop_amounts": [0, 0],
+    }
+    assert analyze_flow_strategy(s, "t_op_0", rng=RNG()).empty
+
+
+def interval_spec(intervals, domains, functions, total, drop=None, **kw):
+    spec = {
+        "total_dispatch_amount": total,
+        "specific_interval": {
+            "use": True,
+            "time_type": kw.get("time_type", "relative"),
+            "intervals": intervals,
+            "dispatch_rules": {"domains": domains, "functions": functions},
+        },
+    }
+    if drop:
+        spec["specific_interval"]["drop_simulation"] = drop
+    if "time_zone" in kw:
+        spec["specific_interval"]["time_zone"] = kw["time_zone"]
+    return flow(spec)
+
+
+def test_interval_constant_rate_uniform_split():
+    # rate 1 over 10 seconds -> 10 equal slots of total/10 each.
+    s = interval_spec([[0, 10]], [[0.0, 10.0]], ["1"], 100)
+    sched = analyze_flow_strategy(s, "t_op_0", rng=RNG())
+    assert sched.amounts == [10] * 10
+    assert sched.timings == [0.0] + [1.0] * 9
+    assert sched.total_sent == 100
+
+
+def test_interval_total_preserved_for_odd_totals():
+    # residual-carry integerization preserves the exact total
+    # (strategy.py:361-382).
+    for total in (7, 31, 97, 1000):
+        s = interval_spec([[0, 7]], [[0.0, 6.28]], ["math.sin(t)+1"], total)
+        sched = analyze_flow_strategy(s, "t_op_0", rng=RNG())
+        assert sched.total_sent == total, total
+
+
+def test_interval_multi_interval_proportional_split():
+    # two intervals, rates 1 and 3 over equal lengths -> 25%/75% split.
+    s = interval_spec(
+        [[0, 10], [10, 20]],
+        [[0.0, 10.0], [0.0, 10.0]],
+        ["1", "3"],
+        200,
+    )
+    sched = analyze_flow_strategy(s, "t_op_0", rng=RNG())
+    assert sched.total_sent == 200
+    assert sum(sched.amounts[:10]) == 50
+    assert sum(sched.amounts[10:]) == 150
+
+
+def test_interval_negative_rate_sends_nothing():
+    s = interval_spec([[0, 5]], [[0.0, 5.0]], ["-1"], 50)
+    assert analyze_flow_strategy(s, "t_op_0", rng=RNG()).empty
+
+
+def test_interval_spike_shape():
+    # A gaussian-bump spike: most traffic lands mid-interval.
+    s = interval_spec(
+        [[0, 20]], [[-3.0, 3.0]], ["math.exp(-t*t)"], 1000
+    )
+    sched = analyze_flow_strategy(s, "t_op_0", rng=RNG())
+    assert sched.total_sent == 1000
+    mid = sum(sched.amounts[8:12])
+    assert mid > 500, f"spike not concentrated: {sched.amounts}"
+
+
+def test_interval_drop_amounts_distribution():
+    s = interval_spec(
+        [[0, 10]], [[0.0, 10.0]], ["1"], 100, drop={"drop_amounts": [40]}
+    )
+    sched = analyze_flow_strategy(s, "t_op_0", rng=RNG())
+    assert sched.total_dropped == 40
+
+
+def test_interval_absolute_time():
+    now = datetime(2026, 1, 1, 0, 0, 0)
+    # absolute intervals are per-round indexable: one list of [start, end]
+    # pairs per round (validate_parameters.py:146-151)
+    s = interval_spec(
+        [[["2026-01-01 00:00:10", "2026-01-01 00:00:15"]]],
+        [[0.0, 5.0]],
+        ["2"],
+        50,
+        time_type="absolute",
+        time_zone="UTC",
+    )
+    sched = analyze_flow_strategy(s, "t_op_0", rng=RNG(), now=now)
+    assert sched.total_sent == 50
+    assert sched.timings[0] == 10.0  # waits until the absolute start
+    assert len(sched.amounts) == 5
+
+
+def test_json_string_input():
+    s = json.dumps(interval_spec([[0, 4]], [[0.0, 4.0]], ["1"], 8))
+    sched = analyze_flow_strategy(s, "t_op_0", rng=RNG())
+    assert sched.total_sent == 8

@@ -1,0 +1,155 @@
+"""Validation semantics vs reference ``validate_parameters.py:24-225``."""
+
+import pytest
+
+from olearning_sim_tpu.deviceflow.validate import check_notify_start_params, check_strategy
+
+
+def rt(p=None):
+    s = {"real_time_dispatch": {"use_strategy": True}}
+    if p is not None:
+        s["real_time_dispatch"]["drop_simulation"] = {"drop_probability": p}
+    return s
+
+
+def timing_flow(**kw):
+    spec = {
+        "use": True,
+        "time_type": kw.get("time_type", "relative"),
+        "timings": kw.get("timings", [0, 5]),
+        "amounts": kw.get("amounts", [5, 5]),
+    }
+    if "time_zone" in kw:
+        spec["time_zone"] = kw["time_zone"]
+    if "drop" in kw:
+        spec["drop_simulation"] = kw["drop"]
+    return {
+        "flow_dispatch": {
+            "use_strategy": True,
+            "total_dispatch_amount": kw.get("total", 10),
+            "specific_timing": spec,
+        }
+    }
+
+
+def test_exactly_one_strategy():
+    ok, msg = check_strategy({})
+    assert not ok and msg == "Must use one strategy"
+    both = {
+        "real_time_dispatch": {"use_strategy": True},
+        "flow_dispatch": {"use_strategy": True},
+    }
+    assert not check_strategy(both)[0]
+    assert check_strategy(rt())[0]
+
+
+def test_real_time_drop_probability_range():
+    assert check_strategy(rt(0.5))[0]
+    assert not check_strategy(rt(1.5))[0]
+    assert not check_strategy(rt(-0.1))[0]
+
+
+def test_flow_requires_one_specific():
+    s = {"flow_dispatch": {"use_strategy": True, "total_dispatch_amount": 10}}
+    ok, msg = check_strategy(s)
+    assert not ok and msg == "Must use one specific strategy"
+
+
+def test_timing_sizes_and_total():
+    assert check_strategy(timing_flow())[0]
+    ok, msg = check_strategy(timing_flow(amounts=[5]))
+    assert not ok and "same size" in msg
+    ok, msg = check_strategy(timing_flow(amounts=[5, 6]))
+    assert not ok and msg == "amounts not equal total dispatch amount"
+
+
+def test_timing_negative_relative_time():
+    ok, msg = check_strategy(timing_flow(timings=[-1, 5]))
+    assert not ok and "must >= 0" in msg
+
+
+def test_absolute_requires_timezone():
+    s = timing_flow(
+        time_type="absolute",
+        timings=[["2026-01-01 00:00:00", "2026-01-01 00:01:00"]],
+    )
+    ok, msg = check_strategy(s)
+    assert not ok and "time zone" in msg
+    s = timing_flow(
+        time_type="absolute",
+        time_zone="Mars/Olympus",
+        timings=[["2026-01-01 00:00:00", "2026-01-01 00:01:00"]],
+    )
+    assert not check_strategy(s)[0]
+    s = timing_flow(
+        time_type="absolute",
+        time_zone="Asia/Shanghai",
+        timings=[["2026-01-01 00:00:00", "2026-01-01 00:01:00"]],
+    )
+    assert check_strategy(s)[0]
+    s = timing_flow(
+        time_type="absolute",
+        time_zone="Asia/Shanghai",
+        timings=[["not-a-date", "2026-01-01 00:01:00"]],
+    )
+    ok, msg = check_strategy(s)
+    assert not ok and "absolute time format error" in msg
+
+
+def test_drop_mutual_exclusion_and_ranges():
+    ok, msg = check_strategy(
+        timing_flow(drop={"drop_probability": [0.1, 0.2], "drop_amounts": [1, 1]})
+    )
+    assert not ok and "can't be set at the same time" in msg
+    assert not check_strategy(timing_flow(drop={"drop_probability": [0.1, 1.2]}))[0]
+    ok, msg = check_strategy(timing_flow(drop={"drop_amounts": [10, 20]}))
+    assert not ok and msg == "drop amounts sum > total dispatch amount"
+    assert check_strategy(timing_flow(drop={"drop_probability": [0.1, 0.9]}))[0]
+
+
+def interval_flow(intervals, domains, functions, **kw):
+    spec = {
+        "use": True,
+        "time_type": kw.get("time_type", "relative"),
+        "intervals": intervals,
+        "dispatch_rules": {"domains": domains, "functions": functions},
+    }
+    if "drop" in kw:
+        spec["drop_simulation"] = kw["drop"]
+    return {
+        "flow_dispatch": {
+            "use_strategy": True,
+            "total_dispatch_amount": kw.get("total", 100),
+            "specific_interval": spec,
+        }
+    }
+
+
+def test_interval_monotonicity():
+    assert check_strategy(interval_flow([[1, 2], [2, 3]], [[0, 1], [0, 1]], ["t", "t"]))[0]
+    ok, msg = check_strategy(interval_flow([[1, 1], [2, 3]], [[0, 1], [0, 1]], ["t", "t"]))
+    assert not ok and msg == "relative time value error"
+    ok, msg = check_strategy(interval_flow([[1, 3], [2, 4]], [[0, 1], [0, 1]], ["t", "t"]))
+    assert not ok and msg == "relative time value error"
+
+
+def test_interval_sizes_and_domains():
+    ok, msg = check_strategy(interval_flow([[0, 5]], [[0, 1], [0, 1]], ["t"]))
+    assert not ok and "same size" in msg
+    ok, msg = check_strategy(interval_flow([[0, 5]], [[1, 1]], ["t"]))
+    assert not ok and "right value must be greater" in msg
+    # function not in t -> evaluation failure message
+    ok, msg = check_strategy(interval_flow([[0, 5]], [[0, 1]], ["undefined_var + 1"]))
+    assert not ok and "variable must be t" in msg
+    assert check_strategy(interval_flow([[0, 5]], [[0.0, 6.28]], ["math.sin(t)+1"]))[0]
+
+
+def test_notify_start_contract():
+    ok, msg = check_notify_start_params("logical_simulation", "not json{")
+    assert not ok and msg == "strategy not json format"
+    ok, msg = check_notify_start_params("gpu_simulation", "{}")
+    assert not ok and msg == "compute resource error"
+    import json
+
+    ok, msg = check_notify_start_params("device_simulation", json.dumps(rt()))
+    assert ok

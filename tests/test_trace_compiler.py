@@ -1,0 +1,98 @@
+"""Trace compiler: schedules -> per-client masks, and engine integration."""
+
+import jax
+import numpy as np
+
+from olearning_sim_tpu.deviceflow import compile_trace
+from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+
+def flow_timing(total, timings, amounts, drop=None):
+    spec = {
+        "use": True,
+        "time_type": "relative",
+        "timings": timings,
+        "amounts": amounts,
+    }
+    if drop:
+        spec["drop_simulation"] = drop
+    return {
+        "flow_dispatch": {
+            "use_strategy": True,
+            "total_dispatch_amount": total,
+            "specific_timing": spec,
+        }
+    }
+
+
+def test_none_strategy_all_participate():
+    tr = compile_trace(None, 100, 0)
+    assert tr.num_released == 100
+    assert tr.num_dropped == 0
+    assert (tr.arrival_time == 0).all()
+
+
+def test_flow_schedule_maps_to_clients():
+    tr = compile_trace(flow_timing(60, [0, 5, 10], [10, 20, 30]), 100, 0, seed=1)
+    assert tr.num_released == 60
+    # 40 clients never released this round
+    assert np.isinf(tr.arrival_time).sum() == 40
+    # arrival times take exactly the scheduled values
+    finite = tr.arrival_time[np.isfinite(tr.arrival_time)]
+    vals, counts = np.unique(finite, return_counts=True)
+    assert list(vals) == [0.0, 5.0, 15.0]
+    assert list(counts) == [10, 20, 30]
+    assert tr.round_duration() == 15.0
+
+
+def test_drops_reduce_participation():
+    tr = compile_trace(
+        flow_timing(100, [0], [100], drop={"drop_amounts": [30]}), 100, 0, seed=2
+    )
+    assert tr.num_released == 70
+    assert tr.num_dropped == 30
+
+
+def test_determinism_and_round_variation():
+    a = compile_trace(flow_timing(50, [0], [50]), 100, 3, seed=5)
+    b = compile_trace(flow_timing(50, [0], [50]), 100, 3, seed=5)
+    assert (a.participate == b.participate).all()
+    c = compile_trace(flow_timing(50, [0], [50]), 100, 4, seed=5)
+    assert not (a.participate == c.participate).all()  # reshuffled per round
+
+
+def test_real_time_drop_probability():
+    s = {
+        "real_time_dispatch": {
+            "use_strategy": True,
+            "drop_simulation": {"drop_probability": 0.3},
+        }
+    }
+    tr = compile_trace(s, 2000, 0, seed=3)
+    assert 0.6 < tr.num_released / 2000 < 0.8
+    assert tr.num_dropped == 2000 - tr.num_released
+
+
+def test_surplus_schedule_truncated():
+    # schedule releases more messages than clients -> surplus ignored
+    tr = compile_trace(flow_timing(500, [0], [500]), 100, 0)
+    assert tr.num_released == 100
+
+
+def test_trace_drives_engine():
+    """Full integration: churn trace -> participation mask -> round_step."""
+    plan = make_mesh_plan(dp=8)
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (16,), "num_classes": 4}, input_shape=(8,),
+    )
+    ds = make_synthetic_dataset(0, 64, 8, (8,), 4).pad_for(plan, 2).place(plan)
+    state = core.init_state(jax.random.key(0))
+
+    tr = compile_trace(flow_timing(40, [0, 2], [20, 20]), ds.num_clients, 0, seed=9)
+    participate = jax.device_put(tr.participate, plan.client_sharding())
+    state, metrics = core.round_step(state, ds, participate=participate)
+    assert int(metrics.clients_trained) == 40
