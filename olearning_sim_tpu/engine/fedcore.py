@@ -63,6 +63,10 @@ class RoundMetrics(struct.PyTreeNode):
     mean_loss: jnp.ndarray      # weight-averaged local training loss
     weight_sum: jnp.ndarray     # total aggregation weight (participants)
     clients_trained: jnp.ndarray  # number of clients with weight > 0
+    # Per-client mean local loss [C] (sharded over dp). Finiteness doubles as
+    # the success signal replacing subprocess exit codes
+    # (``utils_run_task.py:490-494``).
+    client_loss: jnp.ndarray
 
 
 @dataclasses.dataclass(frozen=True)
@@ -178,7 +182,13 @@ class FedCore:
             jnp.arange(cfg.max_local_steps),
         )
         delta = jax.tree.map(jnp.subtract, params, global_params)
-        mean_loss = losses.sum() / jnp.maximum(steps_eff, 1).astype(jnp.float32)
+        # NaN for clients that ran zero steps: "no work performed" must not
+        # read as success downstream (finiteness is the success signal).
+        mean_loss = jnp.where(
+            steps_eff > 0,
+            losses.sum() / jnp.maximum(steps_eff, 1).astype(jnp.float32),
+            jnp.float32(jnp.nan),
+        )
         return delta, mean_loss
 
     # ----------------------------------------------------------- round step
@@ -231,9 +241,12 @@ class FedCore:
                 sum_w = sum_w + bw.sum()
                 sum_loss = sum_loss + (bw * losses).sum()
                 count = count + (bw > 0).sum().astype(jnp.float32)
-                return (sum_delta, sum_w, sum_loss, count), None
+                return (sum_delta, sum_w, sum_loss, count), losses
 
-            (sum_delta, sum_w, sum_loss, count), _ = jax.lax.scan(block_step, init, xs)
+            (sum_delta, sum_w, sum_loss, count), block_losses = jax.lax.scan(
+                block_step, init, xs
+            )
+            client_loss = block_losses.reshape((c_local,))
 
             # Cross-device FedAvg: the Pulsar gradient transport of the
             # reference becomes one psum over the dp axis of the ICI mesh.
@@ -257,16 +270,20 @@ class FedCore:
                 mean_loss=sum_loss / denom,
                 weight_sum=sum_w,
                 clients_trained=count,
+                client_loss=client_loss,
             )
             return new_params, new_opt_state, round_idx + 1, metrics
 
         rep = P()
         cl = P("dp")
+        metrics_specs = RoundMetrics(
+            mean_loss=rep, weight_sum=rep, clients_trained=rep, client_loss=cl
+        )
         shard_fn = jax.shard_map(
             shard_body,
             mesh=mesh,
             in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl),
-            out_specs=(rep, rep, rep, rep),
+            out_specs=(rep, rep, rep, metrics_specs),
         )
 
         @functools.partial(jax.jit, donate_argnums=(0,))

@@ -1,0 +1,266 @@
+"""SimulationRunner — the round-loop driver (the reference RayRunner rebuilt
+for the TPU engine).
+
+Reference semantics (``ols_core/taskMgr/run_task.py:212-322``): for each
+round x operator: operator-flow start barrier -> optional deviceflow
+NotifyStart -> execute the operator over all virtual devices -> deviceflow
+NotifyComplete -> per-(data, device-class) success/failed accounting persisted
+to the task table -> operator-flow stop barrier (tolerant on the final
+round).
+
+Execution differences (the point of the rebuild):
+
+- "execute the operator" is ONE compiled ``FedCore.round_step`` advancing the
+  whole population, not ``pool.map_unordered`` over actors spawning a
+  subprocess per phone (``utils_run_task.py:481-514``);
+- deviceflow behavior comes from the trace compiler as masks (participation /
+  drops) applied inside the same program; when a DeviceFlowService is
+  attached, the runner also walks the flow lifecycle so hybrid tasks and
+  external aggregators observe identical Register/NotifyStart/NotifyComplete
+  semantics;
+- success/failed counts per device class are derived from per-client finite-
+  loss masks instead of subprocess exit codes (``utils_run_task.py:490-494``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from olearning_sim_tpu.deviceflow.service import DeviceFlowService
+from olearning_sim_tpu.deviceflow.trace_compiler import ClientTrace, compile_trace
+from olearning_sim_tpu.engine.client_data import ClientDataset
+from olearning_sim_tpu.engine.fedcore import FedCore
+from olearning_sim_tpu.taskmgr.operator_flow import OperatorFlowController
+from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+from olearning_sim_tpu.utils.logging import Logger
+
+
+@dataclasses.dataclass
+class OperatorSpec:
+    """One operator in the flow (reference ``Operator`` proto,
+    ``taskService.proto:68-76``). ``kind``:
+
+    - ``train``: one FedCore round step;
+    - ``eval``: centralized evaluation of the global model;
+    - ``custom``: host callback ``fn(runner, round_idx, operator) -> dict`` —
+      the escape hatch for arbitrary user operator code (reference operator
+      zips, ``base_operator.py``).
+    """
+
+    name: str
+    kind: str = "train"
+    use_deviceflow: bool = False
+    deviceflow_strategy: str = ""
+    inputs: List[str] = dataclasses.field(default_factory=list)
+    custom_fn: Optional[Callable[["SimulationRunner", int, "OperatorSpec"], Dict[str, Any]]] = None
+
+
+@dataclasses.dataclass
+class DataPopulation:
+    """One target-data entry: a client population plus its device-class
+    layout (reference ``TargetData`` + ``TotalSimulation``,
+    ``taskService.proto:18-32``)."""
+
+    name: str
+    dataset: ClientDataset  # placed + padded
+    device_classes: List[str]  # class names, e.g. ["high", "low"]
+    class_of_client: np.ndarray  # [C] int index into device_classes (host)
+    nums: List[int]  # target simulated devices per class
+    dynamic_nums: List[int]  # failure allowance per class
+    eval_data: Optional[tuple] = None  # (x, y) central eval set
+
+
+class SimulationRunner:
+    def __init__(
+        self,
+        task_id: str,
+        core: FedCore,
+        populations: List[DataPopulation],
+        operators: List[OperatorSpec],
+        rounds: int,
+        task_repo: Optional[TaskTableRepo] = None,
+        deviceflow: Optional[DeviceFlowService] = None,
+        operator_flow: Optional[OperatorFlowController] = None,
+        trace_seed: int = 0,
+        logger: Optional[Logger] = None,
+    ):
+        self.task_id = task_id
+        self.core = core
+        self.populations = populations
+        self.operators = operators
+        self.rounds = int(rounds)
+        self.task_repo = task_repo if task_repo is not None else TaskTableRepo()
+        self.deviceflow = deviceflow
+        self.operator_flow = operator_flow or OperatorFlowController(task_id, rounds)
+        self.trace_seed = trace_seed
+        self.logger = logger if logger is not None else Logger()
+        self.states: Dict[str, Any] = {}
+        self.history: List[Dict[str, Any]] = []
+
+        if not self.task_repo.has_task(task_id):
+            self.task_repo.add_task(task_id)
+        self._write_targets()
+
+    # ------------------------------------------------------------ accounting
+    def _write_targets(self) -> None:
+        """Persist logical_target in the reference shape
+        (``run_task.py:155-183``)."""
+        target = [
+            {
+                "name": p.name,
+                "simulation_target": {
+                    "devices": list(p.device_classes),
+                    "nums": list(p.nums),
+                },
+            }
+            for p in self.populations
+        ]
+        self.task_repo.set_item_value(
+            self.task_id, "logical_target", json.dumps({"logical_target": target})
+        )
+
+    def _analyze_results(self, operator: OperatorSpec, round_idx: int,
+                         ok_by_population: Dict[str, np.ndarray]) -> None:
+        """Reference ``analyze_results`` (``run_task.py:149-210``): rebuild
+        per-(data, class) success/failed counts fresh each (round, operator)
+        and persist round/operator/result."""
+        result = []
+        for p in self.populations:
+            ok = ok_by_population.get(p.name)
+            success = [0] * len(p.device_classes)
+            failed = [0] * len(p.device_classes)
+            if ok is not None:
+                real = p.dataset.num_real_clients
+                cls = p.class_of_client[:real]
+                for ci in range(len(p.device_classes)):
+                    mask = cls == ci
+                    success[ci] = int(np.logical_and(mask, ok[:real]).sum())
+                    failed[ci] = int(np.logical_and(mask, ~ok[:real]).sum())
+            result.append(
+                {
+                    "name": p.name,
+                    "simulation_target": {
+                        "devices": list(p.device_classes),
+                        "success_num": success,
+                        "failed_num": failed,
+                    },
+                }
+            )
+        repo = self.task_repo
+        repo.set_item_value(self.task_id, "logical_round", round_idx + 1)
+        repo.set_item_value(self.task_id, "logical_operator", operator.name)
+        repo.set_item_value(
+            self.task_id, "logical_result", json.dumps({"logical_result": result})
+        )
+
+    # ------------------------------------------------------------- deviceflow
+    def _flow_start(self, operator: OperatorSpec, round_idx: int) -> Optional[str]:
+        if self.deviceflow is None or not operator.use_deviceflow:
+            return None
+        routing_key = f"{self.task_id}_{operator.name}_{round_idx}"
+        ok, msg = self.deviceflow.notify_start(
+            self.task_id, routing_key, "logical_simulation",
+            operator.deviceflow_strategy or "{}",
+        )
+        if not ok:
+            raise RuntimeError(f"deviceflow NotifyStart failed for {routing_key}: {msg}")
+        return routing_key
+
+    def _flow_complete(self, routing_key: Optional[str]) -> None:
+        if self.deviceflow is None or routing_key is None:
+            return
+        ok, msg = self.deviceflow.notify_complete(
+            self.task_id, routing_key, "logical_simulation"
+        )
+        if not ok:
+            raise RuntimeError(f"deviceflow NotifyComplete failed for {routing_key}: {msg}")
+
+    # -------------------------------------------------------------- operators
+    def _run_train(self, p: DataPopulation, round_idx: int,
+                   operator: OperatorSpec) -> Dict[str, Any]:
+        trace = compile_trace(
+            json.loads(operator.deviceflow_strategy) if (
+                operator.use_deviceflow and operator.deviceflow_strategy
+            ) else None,
+            p.dataset.num_clients,
+            round_idx,
+            task_id=self.task_id,
+            operator=operator.name,
+            seed=self.trace_seed,
+        )
+        participate = jax.device_put(
+            trace.participate, self.core.plan.client_sharding()
+        )
+        state = self.states[p.name]
+        state, metrics = self.core.round_step(state, p.dataset, participate=participate)
+        self.states[p.name] = state
+        client_loss = np.asarray(jax.device_get(metrics.client_loss))
+        ok = np.isfinite(client_loss)
+        return {
+            "mean_loss": float(metrics.mean_loss),
+            "clients_trained": int(metrics.clients_trained),
+            "released": trace.num_released,
+            "dropped": trace.num_dropped,
+            "sim_duration_s": trace.round_duration(),
+            "ok_mask": ok,
+        }
+
+    def _run_eval(self, p: DataPopulation) -> Dict[str, Any]:
+        if p.eval_data is None:
+            return {"eval_loss": None, "eval_acc": None}
+        x, y = p.eval_data
+        loss, acc = self.core.evaluate(self.states[p.name].params, x, y)
+        return {"eval_loss": loss, "eval_acc": acc}
+
+    # -------------------------------------------------------------------- run
+    def run(self) -> List[Dict[str, Any]]:
+        for p in self.populations:
+            if p.name not in self.states:
+                self.states[p.name] = self.core.init_state(
+                    jax.random.key(hash(self.task_id) & 0x7FFFFFFF)
+                )
+
+        for round_idx in range(self.rounds):
+            if not self.operator_flow.start():
+                raise RuntimeError(f"round {round_idx}: operator-flow start failed")
+
+            round_record: Dict[str, Any] = {"round": round_idx}
+            for operator in self.operators:
+                routing_key = self._flow_start(operator, round_idx)
+                ok_by_population: Dict[str, np.ndarray] = {}
+                op_record: Dict[str, Any] = {}
+                for p in self.populations:
+                    if operator.kind == "train":
+                        r = self._run_train(p, round_idx, operator)
+                        ok_by_population[p.name] = r.pop("ok_mask")
+                    elif operator.kind == "eval":
+                        r = self._run_eval(p)
+                        ok_by_population[p.name] = np.ones(
+                            p.dataset.num_clients, bool
+                        )
+                    elif operator.kind == "custom":
+                        r = operator.custom_fn(self, round_idx, operator) or {}
+                        ok_by_population[p.name] = r.pop(
+                            "ok_mask", np.ones(p.dataset.num_clients, bool)
+                        )
+                    else:
+                        raise ValueError(f"unknown operator kind {operator.kind!r}")
+                    op_record[p.name] = r
+                self._flow_complete(routing_key)
+                self._analyze_results(operator, round_idx, ok_by_population)
+                round_record[operator.name] = op_record
+
+            self.history.append(round_record)
+
+            if not self.operator_flow.stop():
+                if round_idx < self.rounds - 1:
+                    raise RuntimeError(f"round {round_idx}: operator-flow stop failed")
+                # Final round: the work is done; don't block on the barrier
+                # (reference ``run_task.py:319-322``).
+                break
+        return self.history

@@ -1,0 +1,17 @@
+from olearning_sim_tpu.taskmgr.status import (
+    TaskStatus,
+    calculate_conditions,
+    combine_task_status,
+)
+from olearning_sim_tpu.taskmgr.operator_flow import (
+    OperatorFlowController,
+    register_flow_strategy,
+)
+
+__all__ = [
+    "OperatorFlowController",
+    "TaskStatus",
+    "calculate_conditions",
+    "combine_task_status",
+    "register_flow_strategy",
+]

@@ -1,0 +1,190 @@
+"""Operator-flow round barriers.
+
+Reference: ``ols_core/taskMgr/utils/operatorflow.py:21-352`` — each round of
+the operator flow can be gated by a start condition and a stop condition,
+used to synchronize the simulation with an external aggregation service:
+
+- ``""`` (empty): no barrier, proceed immediately;
+- ``waiting_for_global_aggregation``: poll an external *selection service*
+  for its current round index; start when it answers, stop when its round
+  advanced by exactly 1 (``operatorflow.py:135-237``);
+- ``sample_and_aggregation`` / ``sample_dc_and_aggregation``: sample client
+  submissions into a staging directory, then wait for an
+  ``aggregation_finished.txt`` flag file (``operatorflow.py:240-352``; the
+  reference hard-codes researcher paths — here the paths and the sampler are
+  parameters).
+
+All strategies share the (wait_interval, total_timeout) polling contract from
+``StrategyCondition`` (``taskService.proto:62-66``). Strategies are a
+registry so deployments can plug their own barriers.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from olearning_sim_tpu.utils.logging import Logger
+
+# A strategy factory returns an object with:
+#   start(ctx) -> (ok: bool, current_round: Optional[int])
+#   stop(ctx, previous_round: int) -> (ok: bool, current_round: Optional[int])
+_STRATEGIES: Dict[str, Callable[..., Any]] = {}
+
+
+def register_flow_strategy(name: str, factory: Callable[..., Any]) -> None:
+    _STRATEGIES[name] = factory
+
+
+class ImmediateBarrier:
+    """Empty strategy: no synchronization (reference ``operatorflow.py:49-50``)."""
+
+    def start(self, ctx):
+        return True, None
+
+    def stop(self, ctx, previous_round):
+        return True, None
+
+
+class PollingRoundBarrier:
+    """``waiting_for_global_aggregation``: an external service owns the round
+    counter. ``round_provider()`` returns its current round index (or None on
+    error); the reference polls a selection service over WebSocket
+    (``operatorflow.py:139-237``)."""
+
+    def __init__(self, round_provider: Callable[[], Optional[int]]):
+        self.round_provider = round_provider
+
+    def _poll(self, wait_interval, total_timeout, predicate):
+        start = time.time()
+        wait_interval = max(float(wait_interval), 1e-3)
+        while True:
+            current = self.round_provider()
+            if current is not None and predicate(current):
+                return True, current
+            if time.time() - start >= float(total_timeout):
+                return False, None
+            time.sleep(wait_interval)
+
+    def start(self, ctx):
+        return self._poll(
+            ctx.get("wait_interval", 0), ctx.get("total_timeout", 0), lambda r: True
+        )
+
+    def stop(self, ctx, previous_round):
+        # The service's round must advance by exactly 1 past ours
+        # (reference ``operatorflow.py:94-107``).
+        return self._poll(
+            ctx.get("wait_interval", 0),
+            ctx.get("total_timeout", 0),
+            lambda r: r - previous_round == 1,
+        )
+
+
+class FlagFileBarrier:
+    """``sample_and_aggregation`` family: run an optional sampler at start,
+    then stop when the aggregator writes a flag file
+    (reference ``operatorflow.py:240-352``, paths parameterized)."""
+
+    def __init__(
+        self,
+        flag_path: str,
+        sampler: Optional[Callable[[Dict[str, Any]], bool]] = None,
+        clear_flag: bool = True,
+    ):
+        self.flag_path = flag_path
+        self.sampler = sampler
+        self.clear_flag = clear_flag
+
+    def start(self, ctx):
+        if self.sampler is not None and not self.sampler(ctx):
+            return False, None
+        return True, None
+
+    def stop(self, ctx, previous_round):
+        start = time.time()
+        wait_interval = max(float(ctx.get("wait_interval", 0)), 1e-3)
+        total_timeout = float(ctx.get("total_timeout", 0))
+        while True:
+            if os.path.exists(self.flag_path):
+                if self.clear_flag:
+                    try:
+                        os.remove(self.flag_path)
+                    except OSError:
+                        pass
+                return True, None
+            if time.time() - start >= total_timeout:
+                return False, None
+            time.sleep(wait_interval)
+
+
+register_flow_strategy("", lambda **_: ImmediateBarrier())
+register_flow_strategy(
+    "waiting_for_global_aggregation",
+    lambda round_provider=None, **_: PollingRoundBarrier(round_provider),
+)
+register_flow_strategy(
+    "sample_and_aggregation",
+    lambda flag_path="aggregation_finished.txt", sampler=None, **_: FlagFileBarrier(
+        flag_path, sampler
+    ),
+)
+register_flow_strategy(
+    "sample_dc_and_aggregation",
+    lambda flag_path="aggregation_finished.txt", sampler=None, **_: FlagFileBarrier(
+        flag_path, sampler
+    ),
+)
+
+
+class OperatorFlowController:
+    """Round-loop barrier driver (reference ``OperatorFlow``,
+    ``operatorflow.py:39-132``): tracks the external round counter across
+    start/stop; unknown strategies fail loudly."""
+
+    def __init__(
+        self,
+        task_id: str,
+        rounds: int,
+        start_params: Optional[Dict[str, Any]] = None,
+        stop_params: Optional[Dict[str, Any]] = None,
+        strategy_kwargs: Optional[Dict[str, Any]] = None,
+        logger: Optional[Logger] = None,
+    ):
+        self.task_id = task_id
+        self.rounds = int(rounds)
+        self.start_params = dict(start_params or {})
+        self.stop_params = dict(stop_params or {})
+        self.strategy_kwargs = dict(strategy_kwargs or {})
+        self.logger = logger if logger is not None else Logger()
+        self.current_round = 0
+
+    def _barrier(self, name: str):
+        if name not in _STRATEGIES:
+            self.logger.error(
+                task_id=self.task_id, system_name="Engine", module_name="OperatorFlow",
+                message=f"unknown operator-flow strategy {name!r}",
+            )
+            return None
+        return _STRATEGIES[name](**self.strategy_kwargs)
+
+    def start(self) -> bool:
+        name = self.start_params.get("strategy", "")
+        barrier = self._barrier(name)
+        if barrier is None:
+            return False
+        ok, current = barrier.start(self.start_params)
+        if ok and current is not None:
+            self.current_round = current
+        return bool(ok)
+
+    def stop(self) -> bool:
+        name = self.stop_params.get("strategy", "")
+        barrier = self._barrier(name)
+        if barrier is None:
+            return False
+        ok, current = barrier.stop(self.stop_params, self.current_round)
+        if ok and current is not None:
+            self.current_round = current
+        return bool(ok)

@@ -1,0 +1,70 @@
+"""Task table: the control-plane state row per task.
+
+Reference: ``taskmgr_table`` accessed via TaskTableRepo
+(``ols_core/taskMgr/utils/utils.py:29-267``); columns inferred from call
+sites across task_manager.py / run_task.py. Same narrow get/set-by-task_id
+interface over a pluggable TableRepo backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from olearning_sim_tpu.utils.repo import MemoryTableRepo, SqliteTableRepo, TableRepo
+
+TASK_COLUMNS = [
+    "task_id",
+    "user_id",
+    "task_status",
+    "task_params",        # full task JSON
+    "total_simulation",   # {"max_round", "operator_name_list", "data_name_list", "total_simulation"}
+    "logical_target",     # {"logical_target": [...]}  per-data device classes + nums
+    "logical_round",      # completed rounds (int)
+    "logical_operator",   # last finished operator name
+    "logical_result",     # {"logical_result": [...]} per-data success/failed counts
+    "device_target",
+    "device_round",
+    "device_operator",
+    "device_result",
+    "job_id",
+    "resource_occupied",
+    "in_queue_time",
+    "submit_task_time",
+    "task_finished_time",
+]
+
+
+class TaskTableRepo:
+    """get/set items keyed by task_id (reference ``utils.py:29-267``)."""
+
+    def __init__(self, backend: Optional[TableRepo] = None, sqlite_path: Optional[str] = None):
+        if backend is not None:
+            self.backend = backend
+        elif sqlite_path is not None:
+            self.backend = SqliteTableRepo(sqlite_path, "taskmgr_table", TASK_COLUMNS)
+        else:
+            self.backend = MemoryTableRepo(TASK_COLUMNS)
+
+    def has_task(self, task_id: str) -> bool:
+        return self.backend.has_item("task_id", task_id)
+
+    def add_task(self, task_id: str, **fields: Any) -> bool:
+        item = {"task_id": [task_id]}
+        for k, v in fields.items():
+            item[k] = [v]
+        return self.backend.add_item(item)
+
+    def get_item_value(self, task_id: str, item: str) -> Any:
+        return self.backend.get_item_value("task_id", task_id, item)
+
+    def set_item_value(self, task_id: str, item: str, value: Any) -> bool:
+        return self.backend.set_item_value("task_id", task_id, item, value)
+
+    def delete_task(self, task_id: str) -> bool:
+        return self.backend.delete_items(task_id=task_id)
+
+    def get_task_ids_by_status(self, status: Any) -> List[str]:
+        return self.backend.get_values_by_conditions("task_id", task_status=status)
+
+    def query_all(self):
+        return self.backend.query_all()

@@ -1,0 +1,152 @@
+"""Table-driven tests of the status calculus
+(reference ``task_manager.py:610-889`` semantics)."""
+
+import pytest
+
+from olearning_sim_tpu.taskmgr.status import (
+    Conditions,
+    SimHalfState,
+    TaskStatus,
+    calculate_conditions,
+    combine_task_status,
+)
+
+
+def task_params(max_round=2, operators=("train",), nums=(10,), dynamic=(2,)):
+    return {
+        "max_round": max_round,
+        "operator_name_list": list(operators),
+        "data_name_list": ["data_0"],
+        "total_simulation": [
+            {"simulation_target": {"devices": ["high"], "nums": list(nums),
+                                   "dynamic_nums": list(dynamic)}}
+        ],
+    }
+
+
+def half(present=True, success=None, failed=None, rnd=None, op=None, nums=(10,)):
+    if not present:
+        return SimHalfState(present=False)
+    target = [{"name": "data_0", "simulation_target": {"devices": ["high"], "nums": list(nums)}}]
+    result = []
+    if success is not None:
+        result = [{
+            "name": "data_0",
+            "simulation_target": {
+                "devices": ["high"],
+                "success_num": list(success),
+                "failed_num": list(failed if failed is not None else [0]),
+            },
+        }]
+    return SimHalfState(present=True, target=target, result=result,
+                        current_round=rnd, operator_name=op)
+
+
+# ---------------------------------------------------------------- conditions
+def test_logical_only_success_at_final_round():
+    c = calculate_conditions(
+        task_params(), half(success=[9], failed=[1], rnd=2, op="train"), half(present=False)
+    )
+    assert c == Conditions(True, False, True, False)
+
+
+def test_logical_only_not_final_round_is_running():
+    c = calculate_conditions(
+        task_params(), half(success=[10], failed=[0], rnd=1, op="train"), half(present=False)
+    )
+    assert not c.logical_success and not c.logical_round_failed
+
+
+def test_logical_only_wrong_last_operator():
+    tp = task_params(operators=("train", "agg"))
+    c = calculate_conditions(tp, half(success=[10], failed=[0], rnd=2, op="train"),
+                             half(present=False))
+    assert not c.logical_success
+
+
+def test_early_fail_exceeds_dynamic():
+    # failures beyond dynamic allowance -> early round-failed
+    c = calculate_conditions(
+        task_params(dynamic=(2,)), half(success=[5], failed=[3], rnd=1, op="train"),
+        half(present=False),
+    )
+    assert c.logical_round_failed and not c.logical_success
+
+
+def test_failures_within_dynamic_allowance_ok():
+    c = calculate_conditions(
+        task_params(dynamic=(2,)), half(success=[8], failed=[2], rnd=2, op="train"),
+        half(present=False),
+    )
+    assert c.logical_success and not c.logical_round_failed
+
+
+def test_insufficient_success_not_success():
+    c = calculate_conditions(
+        task_params(dynamic=(2,)), half(success=[7], failed=[1], rnd=2, op="train"),
+        half(present=False),
+    )
+    # 7 < 10 - 2 and 1 failure <= 2 dynamic: neither success nor early fail
+    assert not c.logical_success and not c.logical_round_failed
+
+
+def test_hybrid_combined_success():
+    """Logical + device successes sum toward nums - dynamic
+    (reference ``task_manager.py:860-887``)."""
+    tp = task_params(nums=(10,), dynamic=(0,))
+    logical = half(success=[6], failed=[0], rnd=2, op="train")
+    device = half(success=[4], failed=[0], rnd=2, op="train")
+    c = calculate_conditions(tp, logical, device)
+    assert c.logical_success and c.device_success
+
+
+def test_hybrid_combined_failure_splits_blame():
+    tp = task_params(nums=(10,), dynamic=(1,))
+    logical = half(success=[4], failed=[1], rnd=1, op="train")
+    device = half(success=[3], failed=[1], rnd=1, op="train")
+    c = calculate_conditions(tp, logical, device)
+    assert c.logical_round_failed and c.device_round_failed
+
+
+def test_hybrid_rounds_not_comparable_no_fail():
+    """Different rounds: failure comparison deferred
+    (reference ``task_manager.py:843``)."""
+    tp = task_params(nums=(10,), dynamic=(0,))
+    logical = half(success=[5], failed=[5], rnd=2, op="train")
+    device = half(success=[0], failed=[0], rnd=1, op="train")
+    c = calculate_conditions(tp, logical, device)
+    assert not c.logical_round_failed and not c.device_round_failed
+
+
+# ------------------------------------------------------------ combine status
+def cond(ls=False, lrf=False, ds=False, drf=False):
+    return Conditions(ls, lrf, ds, drf)
+
+
+@pytest.mark.parametrize(
+    "conditions,logical_status,device_finished,expected",
+    [
+        # contradictions -> FAILED (reference :671-678)
+        (cond(ls=True, lrf=True), TaskStatus.RUNNING, False, TaskStatus.FAILED),
+        (cond(ds=True, drf=True), TaskStatus.RUNNING, False, TaskStatus.FAILED),
+        # both successful -> SUCCEEDED
+        (cond(ls=True, ds=True), TaskStatus.RUNNING, False, TaskStatus.SUCCEEDED),
+        (cond(ls=True, ds=True), TaskStatus.FAILED, True, TaskStatus.SUCCEEDED),
+        # stopped engine, device finished -> STOPPED
+        (cond(ds=True), TaskStatus.STOPPED, True, TaskStatus.STOPPED),
+        # engine finished without logical success -> FAILED
+        (cond(ds=True), TaskStatus.SUCCEEDED, True, TaskStatus.FAILED),
+        (cond(ds=True), TaskStatus.FAILED, False, TaskStatus.FAILED),
+        # logical early-fail -> FAILED
+        (cond(lrf=True, ds=True), TaskStatus.RUNNING, False, TaskStatus.FAILED),
+        # device finished without success -> FAILED
+        (cond(ls=True), TaskStatus.RUNNING, True, TaskStatus.FAILED),
+        # device early-fail -> FAILED
+        (cond(ls=True, drf=True), TaskStatus.RUNNING, False, TaskStatus.FAILED),
+        # still going -> RUNNING
+        (cond(), TaskStatus.RUNNING, False, TaskStatus.RUNNING),
+        (cond(ls=True), TaskStatus.RUNNING, False, TaskStatus.RUNNING),
+    ],
+)
+def test_combine_task_status_table(conditions, logical_status, device_finished, expected):
+    assert combine_task_status(conditions, logical_status, device_finished) == expected
