@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -88,6 +89,7 @@ class SimulationRunner:
         operator_flow: Optional[OperatorFlowController] = None,
         trace_seed: int = 0,
         logger: Optional[Logger] = None,
+        stop_event: Optional[threading.Event] = None,
     ):
         self.task_id = task_id
         self.core = core
@@ -99,6 +101,8 @@ class SimulationRunner:
         self.operator_flow = operator_flow or OperatorFlowController(task_id, rounds)
         self.trace_seed = trace_seed
         self.logger = logger if logger is not None else Logger()
+        self.stop_event = stop_event  # threading.Event; honored between rounds
+        self.stopped = False
         self.states: Dict[str, Any] = {}
         self.history: List[Dict[str, Any]] = []
 
@@ -226,7 +230,15 @@ class SimulationRunner:
                 )
 
         for round_idx in range(self.rounds):
+            if self.stop_event is not None and self.stop_event.is_set():
+                # Cooperative stop between rounds (reference analogue:
+                # stopTask -> Ray job stop, ``task_manager.py:358-455``).
+                self.stopped = True
+                break
             if not self.operator_flow.start():
+                if self.stop_event is not None and self.stop_event.is_set():
+                    self.stopped = True  # barrier abandoned due to stop request
+                    break
                 raise RuntimeError(f"round {round_idx}: operator-flow start failed")
 
             round_record: Dict[str, Any] = {"round": round_idx}
@@ -258,6 +270,9 @@ class SimulationRunner:
             self.history.append(round_record)
 
             if not self.operator_flow.stop():
+                if self.stop_event is not None and self.stop_event.is_set():
+                    self.stopped = True
+                    break
                 if round_idx < self.rounds - 1:
                     raise RuntimeError(f"round {round_idx}: operator-flow stop failed")
                 # Final round: the work is done; don't block on the barrier

@@ -1,0 +1,198 @@
+"""TaskConfig -> engine bridge: ``engine.run(task_json)``.
+
+Realizes SURVEY.md section 7 step 1's goal: the same task-JSON schema the
+reference accepts drives the TPU engine directly. Where the reference ships
+operator *code archives* fetched per task (``utils_runner.py:684-782``) and
+runs them as subprocesses, the rebuild's fast path addresses *builtin*
+operators by name::
+
+    "logical_simulation": {
+        "operator_code_path": "builtin:train",   # or builtin:eval
+        "operator_params": "{ ...engine params json... }"
+    }
+
+Arbitrary user code still works through the ``custom`` operator kind
+(``engine/runner.py``). Engine params schema (all optional, defaults below):
+
+    {
+      "model":     {"name": "cnn4", "overrides": {...}, "input_shape": [32,32,3]},
+      "algorithm": {"name": "fedavg", "local_lr": 0.05, ...},
+      "fedcore":   {"batch_size": 32, "max_local_steps": 10, "block_clients": 64},
+      "data":      {"synthetic": {"seed": 0, "n_local": 20, "num_classes": 10,
+                    "dirichlet_alpha": null, "class_sep": 2.0}, "eval_n": 1024}
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from olearning_sim_tpu.engine.algorithms import from_config as algorithm_from_config
+from olearning_sim_tpu.engine.client_data import (
+    make_central_eval_set,
+    make_synthetic_dataset,
+)
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig, build_fedcore
+from olearning_sim_tpu.engine.runner import (
+    DataPopulation,
+    OperatorSpec,
+    SimulationRunner,
+)
+from olearning_sim_tpu.parallel.mesh import MeshPlan, make_mesh_plan
+from olearning_sim_tpu.proto import taskservice_pb2 as pb
+from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+from olearning_sim_tpu.taskmgr.operator_flow import OperatorFlowController
+
+BUILTIN_PREFIX = "builtin:"
+
+
+def _engine_params(tc: pb.TaskConfig) -> Dict[str, Any]:
+    """Engine params: first builtin operator's operatorParams JSON."""
+    for op in tc.operatorFlow.operator:
+        info = op.logicalSimulationOperatorInfo
+        if info.operatorCodePath.startswith(BUILTIN_PREFIX) and info.operatorParams:
+            return json.loads(info.operatorParams)
+    return {}
+
+
+def _operator_specs(tc: pb.TaskConfig) -> list:
+    specs = []
+    for op in tc.operatorFlow.operator:
+        info = op.logicalSimulationOperatorInfo
+        if not info.operatorCodePath.startswith(BUILTIN_PREFIX):
+            raise ValueError(
+                f"operator {op.name}: only builtin: operators are supported by the "
+                f"task bridge; use SimulationRunner directly for custom code"
+            )
+        kind = info.operatorCodePath[len(BUILTIN_PREFIX):]
+        if kind not in ("train", "eval"):
+            raise ValueError(f"operator {op.name}: unknown builtin operator {kind!r}")
+        specs.append(
+            OperatorSpec(
+                name=op.name,
+                kind=kind,
+                use_deviceflow=op.operationBehaviorController.useController,
+                deviceflow_strategy=op.operationBehaviorController.strategyBehaviorController,
+                inputs=list(op.input),
+            )
+        )
+    return specs
+
+
+def build_runner_from_taskconfig(
+    tc: pb.TaskConfig | str | Dict[str, Any],
+    plan: Optional[MeshPlan] = None,
+    task_repo=None,
+    deviceflow=None,
+    stop_event: Optional["threading.Event"] = None,
+) -> SimulationRunner:
+    """Build a ready-to-run SimulationRunner from a TaskConfig proto or the
+    equivalent task JSON."""
+    if not isinstance(tc, pb.TaskConfig):
+        tc = json2taskconfig(tc)
+    plan = plan if plan is not None else make_mesh_plan()
+    params = _engine_params(tc)
+
+    model_cfg = params.get("model", {})
+    algo_cfg = dict(params.get("algorithm", {}))
+    fed_cfg = params.get("fedcore", {})
+    data_cfg = params.get("data", {})
+
+    cfg = FedCoreConfig(
+        batch_size=int(fed_cfg.get("batch_size", 32)),
+        max_local_steps=int(fed_cfg.get("max_local_steps", 10)),
+        block_clients=int(fed_cfg.get("block_clients", 64)),
+    )
+    algorithm = algorithm_from_config(algo_cfg.pop("name", "fedavg"), **algo_cfg)
+    input_shape = tuple(model_cfg.get("input_shape", [])) or None
+    core = build_fedcore(
+        model_cfg.get("name", "mlp2"),
+        algorithm,
+        plan,
+        cfg,
+        model_overrides=model_cfg.get("overrides"),
+        input_shape=input_shape,
+    )
+
+    syn = data_cfg.get("synthetic", {})
+    num_classes = int(syn.get("num_classes", 10))
+    if input_shape is None:
+        from olearning_sim_tpu.models import get_model
+
+        input_shape = get_model(model_cfg.get("name", "mlp2")).example_input_shape
+
+    populations = []
+    for td in tc.target.targetData:
+        devices = list(td.totalSimulation.deviceTotalSimulation)
+        nums = [int(n) for n in td.totalSimulation.numTotalSimulation]
+        dynamic = [int(n) for n in td.totalSimulation.dynamicNumTotalSimulation]
+        if not dynamic:
+            dynamic = [0] * len(nums)
+        num_clients = sum(nums)
+        ds = make_synthetic_dataset(
+            seed=int(syn.get("seed", 0)),
+            num_clients=num_clients,
+            n_local=int(syn.get("n_local", 20)),
+            input_shape=input_shape,
+            num_classes=num_classes,
+            dirichlet_alpha=syn.get("dirichlet_alpha"),
+            class_sep=float(syn.get("class_sep", 2.0)),
+        ).pad_for(plan, cfg.block_clients).place(plan)
+        cls = np.zeros(ds.num_clients, int)
+        start = 0
+        for ci, n in enumerate(nums):
+            cls[start : start + n] = ci
+            start += n
+        eval_data = None
+        if data_cfg.get("eval_n"):
+            eval_data = make_central_eval_set(
+                int(syn.get("seed", 0)), int(data_cfg["eval_n"]), input_shape,
+                num_classes, class_sep=float(syn.get("class_sep", 2.0)),
+            )
+        populations.append(
+            DataPopulation(
+                name=td.dataName,
+                dataset=ds,
+                device_classes=devices,
+                class_of_client=cls,
+                nums=nums,
+                dynamic_nums=dynamic,
+                eval_data=eval_data,
+            )
+        )
+
+    fs = tc.operatorFlow.flowSetting
+    start_strat = fs.startCondition.logicalSimulationStrategy
+    stop_strat = fs.stopCondition.logicalSimulationStrategy
+    flow = OperatorFlowController(
+        tc.taskID.taskID,
+        fs.round,
+        start_params={
+            "strategy": start_strat.strategyCondition,
+            "wait_interval": start_strat.waitInterval,
+            "total_timeout": start_strat.totalTimeout,
+        },
+        stop_params={
+            "strategy": stop_strat.strategyCondition,
+            "wait_interval": stop_strat.waitInterval,
+            "total_timeout": stop_strat.totalTimeout,
+        },
+        strategy_kwargs=params.get("operator_flow", {}),
+        stop_event=stop_event,
+    )
+
+    return SimulationRunner(
+        task_id=tc.taskID.taskID,
+        core=core,
+        populations=populations,
+        operators=_operator_specs(tc),
+        rounds=fs.round,
+        task_repo=task_repo,
+        deviceflow=deviceflow,
+        operator_flow=flow,
+        stop_event=stop_event,
+    )

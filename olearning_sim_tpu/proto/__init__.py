@@ -1,0 +1,13 @@
+"""Generated protobuf modules + build recipe.
+
+Regenerate with::
+
+    cd olearning_sim_tpu/proto && protoc --python_out=. *.proto
+
+gRPC stubs are hand-written (``olearning_sim_tpu/taskmgr/grpc_service.py``)
+because the image ships protoc without the grpc_python_plugin.
+"""
+
+from olearning_sim_tpu.proto import taskservice_pb2
+
+__all__ = ["taskservice_pb2"]

@@ -1,0 +1,3 @@
+from olearning_sim_tpu.resourcemgr.resource_manager import ResourceManager, TpuTopology
+
+__all__ = ["ResourceManager", "TpuTopology"]

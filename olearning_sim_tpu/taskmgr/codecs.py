@@ -1,0 +1,292 @@
+"""TaskConfig <-> JSON codecs.
+
+The task config exists in three isomorphic forms — protobuf ``TaskConfig``,
+snake_case JSON dict, and the persisted ``task_params`` column — exactly as in
+the reference (``ols_core/taskMgr/utils/utils.py:831-1197``
+``json2taskconfig``/``taskconfig2json``). The JSON key names below are the
+reference's wire format, so task JSONs written for the reference platform load
+unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from olearning_sim_tpu.proto import taskservice_pb2 as pb
+
+
+def _transfer_type(name: str) -> int:
+    return pb.FileTransferType.Value(name if name else "S3")
+
+
+def _strategy_condition(d: Dict[str, Any]) -> pb.StrategyCondition:
+    return pb.StrategyCondition(
+        strategyCondition=d.get("strategy", ""),
+        waitInterval=int(d.get("wait_interval", 0)),
+        totalTimeout=int(d.get("total_timeout", 0)),
+    )
+
+
+def _flow_condition(d: Dict[str, Any]) -> pb.OperatorFlowCondition:
+    return pb.OperatorFlowCondition(
+        logicalSimulationStrategy=_strategy_condition(d.get("logical_simulation", {})),
+        deviceSimulationStrategy=_strategy_condition(d.get("device_simulation", {})),
+    )
+
+
+def _resource_requests(lst) -> list:
+    return [
+        pb.ResourceRequest(
+            dataNameResourceRequest=r.get("name", ""),
+            deviceResourceRequest=r.get("devices", []),
+            numResourceRequest=r.get("num_request", []),
+        )
+        for r in lst
+    ]
+
+
+def json2taskconfig(jsonstring: str | Dict[str, Any]) -> pb.TaskConfig:
+    """Reference ``json2taskconfig`` (``utils.py:831-1027``)."""
+    jsondata = json.loads(jsonstring) if isinstance(jsonstring, str) else jsonstring
+
+    target_json = jsondata.get("target", {})
+    target_data_list = []
+    for data_index, data_json in enumerate(target_json.get("data", [])):
+        ts = data_json.get("total_simulation", {})
+        alloc = data_json.get("allocation", {})
+        rr = alloc.get("running_response", {})
+        target_data_list.append(
+            pb.TargetData(
+                dataName=data_json.get("name", f"data_{data_index}"),
+                dataPath=data_json.get("data_path", ""),
+                dataSplitType=data_json.get("data_split_type", False),
+                dataTransferType=_transfer_type(data_json.get("data_transfer_type", "S3")),
+                taskType=data_json.get("task_type", ""),
+                totalSimulation=pb.TotalSimulation(
+                    deviceTotalSimulation=ts.get("devices", []),
+                    numTotalSimulation=ts.get("nums", []),
+                    dynamicNumTotalSimulation=ts.get("dynamic_nums", []),
+                ),
+                allocation=pb.Allocation(
+                    optimization=alloc.get("optimization", False),
+                    allocationLogicalSimulation=alloc.get("logical_simulation", []),
+                    allocationDeviceSimulation=alloc.get("device_simulation", []),
+                    runningResponse=pb.RunningResponse(
+                        deviceRunningResponse=rr.get("devices", []),
+                        numRunningResponse=rr.get("nums", []),
+                    ),
+                ),
+            )
+        )
+    target = pb.Target(
+        targetData=target_data_list, priority=target_json.get("priority", 0)
+    )
+
+    of_json = jsondata.get("operatorflow", {})
+    fs = of_json.get("flow_setting", {})
+    flow_setting = pb.FlowSetting(
+        round=fs.get("round", 0),
+        startCondition=_flow_condition(fs.get("start", {})),
+        stopCondition=_flow_condition(fs.get("stop", {})),
+    )
+    operators = []
+    for op in of_json.get("operators", []):
+        obc = op.get("operation_behavior_controller", {})
+        model = op.get("model", {})
+        logical = op.get("logical_simulation", {})
+        device = op.get("device_simulation", {})
+        inputs = op.get("input", [])
+        if inputs == "":
+            inputs = []
+        operators.append(
+            pb.Operator(
+                name=op.get("name", ""),
+                operationBehaviorController=pb.OperationBehaviorController(
+                    useController=obc.get("use_gradient_house", False),
+                    strategyBehaviorController=obc.get("strategy_gradient_house", ""),
+                    outboundService=obc.get("outbound_service", ""),
+                ),
+                input=inputs,
+                useData=op.get("use_data", False),
+                model=pb.Model(
+                    useModel=model.get("use_model", False),
+                    modelForTrain=model.get("model_for_train", False),
+                    modelTransferType=_transfer_type(model.get("model_transfer_type", "S3")),
+                    modelPath=model.get("model_path", ""),
+                    modelUpdateStyle=model.get("model_update_style", ""),
+                ),
+                logicalSimulationOperatorInfo=pb.OperatorSimulationInfo(
+                    operatorTransferType=_transfer_type(
+                        logical.get("operator_transfer_type", "S3")
+                    ),
+                    operatorCodePath=logical.get("operator_code_path", ""),
+                    operatorEntryFile=logical.get("operator_entry_file", ""),
+                    operatorParams=logical.get("operator_params", ""),
+                ),
+                deviceSimulationOperatorInfo=pb.OperatorSimulationInfo(
+                    operatorTransferType=_transfer_type(
+                        device.get("operator_transfer_type", "S3")
+                    ),
+                    operatorCodePath=device.get("operator_code_path", ""),
+                    operatorEntryFile=device.get("operator_entry_file", ""),
+                    operatorParams=device.get("operator_params", ""),
+                ),
+            )
+        )
+
+    ls_json = jsondata.get("logical_simulation", {})
+    cu = ls_json.get("computation_unit", {})
+    logical_simulation = pb.LogicalSimulation(
+        computationUnit=pb.ComputationUnit(
+            devicesUnit=cu.get("devices", []),
+            unitSetting=[
+                pb.UnitSetting(numCpus=s.get("num_cpus", 0))
+                for s in cu.get("setting", [])
+            ],
+        ),
+        resourceRequestLogicalSimulation=_resource_requests(
+            ls_json.get("resource_request", [])
+        ),
+    )
+    device_simulation = pb.DeviceSimulation(
+        resourceRequestDeviceSimulation=_resource_requests(
+            jsondata.get("device_simulation", {}).get("resource_request", [])
+        )
+    )
+
+    return pb.TaskConfig(
+        userID=jsondata.get("user_id", ""),
+        taskID=pb.TaskID(taskID=jsondata.get("task_id", "")),
+        target=target,
+        operatorFlow=pb.OperatorFlow(flowSetting=flow_setting, operator=operators),
+        logicalSimulation=logical_simulation,
+        deviceSimulation=device_simulation,
+    )
+
+
+def taskconfig2json(tc: pb.TaskConfig) -> Dict[str, Any]:
+    """Reference ``taskconfig2json`` (``utils.py:1029-1197``); inverse of
+    :func:`json2taskconfig` (round-trip tested)."""
+
+    def cond(c: pb.StrategyCondition) -> Dict[str, Any]:
+        return {
+            "strategy": c.strategyCondition,
+            "wait_interval": c.waitInterval,
+            "total_timeout": c.totalTimeout,
+        }
+
+    def rr_list(lst) -> list:
+        return [
+            {
+                "name": r.dataNameResourceRequest,
+                "devices": list(r.deviceResourceRequest),
+                "num_request": list(r.numResourceRequest),
+            }
+            for r in lst
+        ]
+
+    data = []
+    for td in tc.target.targetData:
+        data.append(
+            {
+                "name": td.dataName,
+                "data_path": td.dataPath,
+                "data_split_type": td.dataSplitType,
+                "data_transfer_type": pb.FileTransferType.Name(td.dataTransferType),
+                "task_type": td.taskType,
+                "total_simulation": {
+                    "devices": list(td.totalSimulation.deviceTotalSimulation),
+                    "nums": list(td.totalSimulation.numTotalSimulation),
+                    "dynamic_nums": list(td.totalSimulation.dynamicNumTotalSimulation),
+                },
+                "allocation": {
+                    "optimization": td.allocation.optimization,
+                    "logical_simulation": list(td.allocation.allocationLogicalSimulation),
+                    "device_simulation": list(td.allocation.allocationDeviceSimulation),
+                    "running_response": {
+                        "devices": list(td.allocation.runningResponse.deviceRunningResponse),
+                        "nums": list(td.allocation.runningResponse.numRunningResponse),
+                    },
+                },
+            }
+        )
+
+    operators = []
+    for op in tc.operatorFlow.operator:
+        operators.append(
+            {
+                "name": op.name,
+                "operation_behavior_controller": {
+                    "use_gradient_house": op.operationBehaviorController.useController,
+                    "strategy_gradient_house": op.operationBehaviorController.strategyBehaviorController,
+                    "outbound_service": op.operationBehaviorController.outboundService,
+                },
+                "input": list(op.input),
+                "use_data": op.useData,
+                "model": {
+                    "use_model": op.model.useModel,
+                    "model_for_train": op.model.modelForTrain,
+                    "model_transfer_type": pb.FileTransferType.Name(op.model.modelTransferType),
+                    "model_path": op.model.modelPath,
+                    "model_update_style": op.model.modelUpdateStyle,
+                },
+                "logical_simulation": {
+                    "operator_transfer_type": pb.FileTransferType.Name(
+                        op.logicalSimulationOperatorInfo.operatorTransferType
+                    ),
+                    "operator_code_path": op.logicalSimulationOperatorInfo.operatorCodePath,
+                    "operator_entry_file": op.logicalSimulationOperatorInfo.operatorEntryFile,
+                    "operator_params": op.logicalSimulationOperatorInfo.operatorParams,
+                },
+                "device_simulation": {
+                    "operator_transfer_type": pb.FileTransferType.Name(
+                        op.deviceSimulationOperatorInfo.operatorTransferType
+                    ),
+                    "operator_code_path": op.deviceSimulationOperatorInfo.operatorCodePath,
+                    "operator_entry_file": op.deviceSimulationOperatorInfo.operatorEntryFile,
+                    "operator_params": op.deviceSimulationOperatorInfo.operatorParams,
+                },
+            }
+        )
+
+    return {
+        "user_id": tc.userID,
+        "task_id": tc.taskID.taskID,
+        "target": {"data": data, "priority": tc.target.priority},
+        "operatorflow": {
+            "flow_setting": {
+                "round": tc.operatorFlow.flowSetting.round,
+                "start": {
+                    "logical_simulation": cond(
+                        tc.operatorFlow.flowSetting.startCondition.logicalSimulationStrategy
+                    ),
+                    "device_simulation": cond(
+                        tc.operatorFlow.flowSetting.startCondition.deviceSimulationStrategy
+                    ),
+                },
+                "stop": {
+                    "logical_simulation": cond(
+                        tc.operatorFlow.flowSetting.stopCondition.logicalSimulationStrategy
+                    ),
+                    "device_simulation": cond(
+                        tc.operatorFlow.flowSetting.stopCondition.deviceSimulationStrategy
+                    ),
+                },
+            },
+            "operators": operators,
+        },
+        "logical_simulation": {
+            "computation_unit": {
+                "devices": list(tc.logicalSimulation.computationUnit.devicesUnit),
+                "setting": [
+                    {"num_cpus": s.numCpus}
+                    for s in tc.logicalSimulation.computationUnit.unitSetting
+                ],
+            },
+            "resource_request": rr_list(tc.logicalSimulation.resourceRequestLogicalSimulation),
+        },
+        "device_simulation": {
+            "resource_request": rr_list(tc.deviceSimulation.resourceRequestDeviceSimulation),
+        },
+    }

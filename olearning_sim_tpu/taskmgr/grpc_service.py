@@ -1,0 +1,109 @@
+"""gRPC surface for TaskMgr.
+
+Wire-compatible with the reference service (``ols_core/proto/taskService.proto:205-211``:
+``/TaskMgr/submitTask`` etc. — the reference proto has no package, so method
+paths use the bare service name). Stubs are hand-written over grpc generic
+handlers because the image ships protoc without grpc_python_plugin.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+from google.protobuf import empty_pb2
+
+from olearning_sim_tpu.proto import taskservice_pb2 as pb
+from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+
+SERVICE_NAME = "TaskMgr"
+
+
+class TaskMgrServicer:
+    """RPC handlers delegating to a TaskManager."""
+
+    def __init__(self, manager: TaskManager):
+        self.manager = manager
+
+    def submitTask(self, request: pb.TaskConfig, context) -> pb.OperationStatus:
+        return pb.OperationStatus(is_success=self.manager.submit_task(request))
+
+    def stopTask(self, request: pb.TaskID, context) -> pb.OperationStatus:
+        return pb.OperationStatus(is_success=self.manager.stop_task(request.taskID))
+
+    def getTaskStatus(self, request: pb.TaskID, context) -> pb.TaskStatus:
+        status = self.manager.get_task_status(request.taskID)
+        return pb.TaskStatus(taskStatus=int(status))
+
+    def getTaskQueue(self, request, context) -> pb.TaskQueue:
+        ids = self.manager.get_task_queue()
+        return pb.TaskQueue(tasks=[pb.TaskID(taskID=i) for i in ids])
+
+    def changeScheduler(self, request: pb.Scheduler, context) -> pb.OperationStatus:
+        return pb.OperationStatus(is_success=self.manager.change_scheduler(request.scheduler))
+
+
+_METHODS = {
+    "submitTask": (pb.TaskConfig, pb.OperationStatus),
+    "stopTask": (pb.TaskID, pb.OperationStatus),
+    "getTaskStatus": (pb.TaskID, pb.TaskStatus),
+    "getTaskQueue": (empty_pb2.Empty, pb.TaskQueue),
+    "changeScheduler": (pb.Scheduler, pb.OperationStatus),
+}
+
+
+def add_taskmgr_to_server(servicer: TaskMgrServicer, server: grpc.Server) -> None:
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString,
+        )
+        for name, (req, resp) in _METHODS.items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+class TaskMgrClient:
+    """Client stub (reference clients call e.g. ``/TaskMgr/submitTask``)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self._calls = {
+            name: channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+            for name, (req, resp) in _METHODS.items()
+        }
+
+    def submitTask(self, tc: pb.TaskConfig) -> pb.OperationStatus:
+        return self._calls["submitTask"](tc)
+
+    def stopTask(self, task_id: str) -> pb.OperationStatus:
+        return self._calls["stopTask"](pb.TaskID(taskID=task_id))
+
+    def getTaskStatus(self, task_id: str) -> pb.TaskStatus:
+        return self._calls["getTaskStatus"](pb.TaskID(taskID=task_id))
+
+    def getTaskQueue(self) -> pb.TaskQueue:
+        return self._calls["getTaskQueue"](empty_pb2.Empty())
+
+    def changeScheduler(self, name: str) -> pb.OperationStatus:
+        return self._calls["changeScheduler"](pb.Scheduler(scheduler=name))
+
+
+def serve_taskmgr(
+    manager: TaskManager,
+    address: str = "127.0.0.1:0",
+    max_workers: int = 8,
+) -> tuple[grpc.Server, int]:
+    """Start a TaskMgr gRPC server; returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_taskmgr_to_server(TaskMgrServicer(manager), server)
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port

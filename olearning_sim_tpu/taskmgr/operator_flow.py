@@ -22,6 +22,7 @@ registry so deployments can plug their own barriers.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -56,30 +57,28 @@ class PollingRoundBarrier:
     def __init__(self, round_provider: Callable[[], Optional[int]]):
         self.round_provider = round_provider
 
-    def _poll(self, wait_interval, total_timeout, predicate):
+    def _poll(self, ctx, predicate):
         start = time.time()
-        wait_interval = max(float(wait_interval), 1e-3)
+        wait_interval = max(float(ctx.get("wait_interval", 0)), 1e-3)
+        total_timeout = float(ctx.get("total_timeout", 0))
+        stop_event = ctx.get("stop_event")
         while True:
+            if stop_event is not None and stop_event.is_set():
+                return False, None  # task stop requested: abandon the barrier
             current = self.round_provider()
             if current is not None and predicate(current):
                 return True, current
-            if time.time() - start >= float(total_timeout):
+            if time.time() - start >= total_timeout:
                 return False, None
             time.sleep(wait_interval)
 
     def start(self, ctx):
-        return self._poll(
-            ctx.get("wait_interval", 0), ctx.get("total_timeout", 0), lambda r: True
-        )
+        return self._poll(ctx, lambda r: True)
 
     def stop(self, ctx, previous_round):
         # The service's round must advance by exactly 1 past ours
         # (reference ``operatorflow.py:94-107``).
-        return self._poll(
-            ctx.get("wait_interval", 0),
-            ctx.get("total_timeout", 0),
-            lambda r: r - previous_round == 1,
-        )
+        return self._poll(ctx, lambda r: r - previous_round == 1)
 
 
 class FlagFileBarrier:
@@ -106,7 +105,10 @@ class FlagFileBarrier:
         start = time.time()
         wait_interval = max(float(ctx.get("wait_interval", 0)), 1e-3)
         total_timeout = float(ctx.get("total_timeout", 0))
+        stop_event = ctx.get("stop_event")
         while True:
+            if stop_event is not None and stop_event.is_set():
+                return False, None
             if os.path.exists(self.flag_path):
                 if self.clear_flag:
                     try:
@@ -151,11 +153,17 @@ class OperatorFlowController:
         stop_params: Optional[Dict[str, Any]] = None,
         strategy_kwargs: Optional[Dict[str, Any]] = None,
         logger: Optional[Logger] = None,
+        stop_event: Optional["threading.Event"] = None,
     ):
         self.task_id = task_id
         self.rounds = int(rounds)
         self.start_params = dict(start_params or {})
         self.stop_params = dict(stop_params or {})
+        # Barrier polls consult this so TaskManager.stop_task is responsive
+        # even while the loop is blocked on an external aggregation service.
+        if stop_event is not None:
+            self.start_params.setdefault("stop_event", stop_event)
+            self.stop_params.setdefault("stop_event", stop_event)
         self.strategy_kwargs = dict(strategy_kwargs or {})
         self.logger = logger if logger is not None else Logger()
         self.current_round = 0

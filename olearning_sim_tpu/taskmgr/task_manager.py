@@ -1,0 +1,385 @@
+"""TaskManager: task lifecycle orchestration.
+
+Reference: ``ols_core/taskMgr/task_manager.py`` (1200 lines) — validates and
+enqueues tasks, runs three daemon threads (schedule loop, resource release,
+interrupt watchdog), recovers its queue from the task table on boot, and
+fuses logical + device status into the final task state. The rebuild keeps
+those semantics with the Ray job layer swapped for the local engine-job
+launcher (multi-host launchers slot in behind the same interface) and MySQL
+swapped for a TableRepo.
+
+Timer defaults mirror ``ols_core/config/config.conf:39-45``:
+schedule 5 s / release 10 s / interrupt-check 300 s, queue timeout 3600 s,
+running timeout 172800 s.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from olearning_sim_tpu.proto import taskservice_pb2 as pb
+from olearning_sim_tpu.taskmgr.codecs import taskconfig2json, json2taskconfig
+from olearning_sim_tpu.taskmgr.jobs import LocalJobLauncher
+from olearning_sim_tpu.taskmgr.scheduler import ScheduleResult, StrategyFactory
+from olearning_sim_tpu.taskmgr.status import (
+    SimHalfState,
+    TaskStatus,
+    calculate_conditions,
+    combine_task_status,
+)
+from olearning_sim_tpu.taskmgr.task_queue import TaskQueue
+from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+from olearning_sim_tpu.taskmgr.validation import validate_task_parameters
+from olearning_sim_tpu.utils.logging import Logger
+
+
+def _total_simulation_entry(tc: pb.TaskConfig) -> Dict[str, Any]:
+    """The persisted ``total_simulation`` blob consumed by the status
+    calculus (reference ``task_manager.py:217-244``)."""
+    return {
+        "max_round": tc.operatorFlow.flowSetting.round,
+        "operator_name_list": [op.name for op in tc.operatorFlow.operator],
+        "data_name_list": [td.dataName for td in tc.target.targetData],
+        "total_simulation": [
+            {
+                "simulation_target": {
+                    "devices": list(td.totalSimulation.deviceTotalSimulation),
+                    "nums": list(td.totalSimulation.numTotalSimulation),
+                    "dynamic_nums": list(td.totalSimulation.dynamicNumTotalSimulation),
+                }
+            }
+            for td in tc.target.targetData
+        ],
+    }
+
+
+class TaskManager:
+    def __init__(
+        self,
+        task_repo: Optional[TaskTableRepo] = None,
+        resource_manager=None,
+        launcher: Optional[LocalJobLauncher] = None,
+        runner_factory: Optional[Callable] = None,
+        deviceflow=None,
+        phone_client=None,
+        scheduler_strategy: str = "default",
+        schedule_interval: float = 5.0,
+        release_interval: float = 10.0,
+        interrupt_interval: float = 300.0,
+        interrupt_queue_time: float = 3600.0,
+        interrupt_running_time: float = 172800.0,
+        auto_create_rows: bool = True,
+        logger: Optional[Logger] = None,
+    ):
+        """``runner_factory(task_config, task_repo, deviceflow, stop_event)``
+        builds the engine runner for a scheduled task; defaults to the
+        task-bridge builtin-operator path."""
+        self.logger = logger if logger is not None else Logger()
+        self._task_repo = task_repo if task_repo is not None else TaskTableRepo()
+        self._resource_manager = resource_manager
+        self._launcher = launcher if launcher is not None else LocalJobLauncher()
+        self._runner_factory = runner_factory or self._default_runner_factory
+        self._deviceflow = deviceflow
+        self._phone_client = phone_client
+        self._task_queue = TaskQueue()
+        self._strategy = StrategyFactory.create_strategy(scheduler_strategy)
+        self._schedule_interval = schedule_interval
+        self._release_interval = release_interval
+        self._interrupt_interval = interrupt_interval
+        self._interrupt_queue_time = interrupt_queue_time
+        self._interrupt_running_time = interrupt_running_time
+        self._auto_create_rows = auto_create_rows
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._threads = []
+        self._recover()
+
+    # ------------------------------------------------------------- recovery
+    def _recover(self) -> None:
+        """Boot recovery (reference ``get_taskqueue_from_repo``,
+        ``task_manager.py:89-155``): re-queue QUEUED rows ordered by
+        in_queue_time; re-adopt rows whose resources are still frozen."""
+        rows = sorted(
+            (r for r in self._task_repo.query_all() if r.get("task_params")),
+            key=lambda r: r.get("in_queue_time") or "",
+        )
+        for row in rows:
+            status = row.get("task_status")
+            if status == TaskStatus.QUEUED.name:
+                try:
+                    tc = json2taskconfig(row["task_params"])
+                    self._task_queue.add(tc)
+                except Exception as e:  # noqa: BLE001
+                    self.logger.error(
+                        task_id=row.get("task_id", ""), system_name="TaskMgr",
+                        module_name="recover", message=f"requeue failed: {e}",
+                    )
+
+    def _default_runner_factory(self, tc, stop_event):
+        from olearning_sim_tpu.engine.task_bridge import build_runner_from_taskconfig
+
+        return build_runner_from_taskconfig(
+            tc, task_repo=self._task_repo, deviceflow=self._deviceflow,
+            stop_event=stop_event,
+        )
+
+    # ------------------------------------------------------------------ RPCs
+    def submit_task(self, tc: pb.TaskConfig) -> bool:
+        """Reference ``submitTask`` (``task_manager.py:186-253``)."""
+        ok, msg = validate_task_parameters(tc)
+        task_id = tc.taskID.taskID
+        if not ok:
+            self.logger.error(task_id=task_id, system_name="TaskMgr",
+                              module_name="submit_task", message=msg)
+            return False
+        with self._lock:
+            if not self._task_repo.has_task(task_id):
+                # The reference requires a pre-inserted UNDONE row from the
+                # GUI backend (``task_manager.py:204-215``); standalone mode
+                # creates it.
+                if not self._auto_create_rows:
+                    return False
+                self._task_repo.add_task(task_id, task_status=TaskStatus.UNDONE.name,
+                                         user_id=tc.userID)
+            status = self._task_repo.get_item_value(task_id, "task_status")
+            if status not in (TaskStatus.UNDONE.name, None):
+                self.logger.error(
+                    task_id=task_id, system_name="TaskMgr", module_name="submit_task",
+                    message=f"task exists with status {status}, not UNDONE",
+                )
+                return False
+            if task_id in self._task_queue:
+                return False
+            repo = self._task_repo
+            repo.set_item_value(task_id, "task_params", json.dumps(taskconfig2json(tc)))
+            repo.set_item_value(
+                task_id, "total_simulation", json.dumps(_total_simulation_entry(tc))
+            )
+            repo.set_item_value(task_id, "task_status", TaskStatus.QUEUED.name)
+            repo.set_item_value(task_id, "in_queue_time", time.strftime("%Y-%m-%d %H:%M:%S"))
+            repo.set_item_value(task_id, "resource_occupied", "0")
+            self._task_queue.add(tc)
+            return True
+
+    def stop_task(self, task_id: str) -> bool:
+        """Reference ``stop_task`` (``task_manager.py:358-455``)."""
+        with self._lock:
+            if task_id in self._task_queue:
+                self._task_queue.delete(task_id)
+                self._task_repo.set_item_value(task_id, "task_status", TaskStatus.STOPPED.name)
+                return True
+            job_id = self._task_repo.get_item_value(task_id, "job_id")
+            if job_id:
+                self._launcher.stop_job(job_id)
+                self._task_repo.set_item_value(task_id, "task_status", TaskStatus.STOPPED.name)
+                return True
+            return self._task_repo.has_task(task_id)
+
+    def get_task_status(self, task_id: str) -> TaskStatus:
+        """Status fusion (reference ``get_task_status``,
+        ``task_manager.py:467-608``)."""
+        with self._lock:
+            if not self._task_repo.has_task(task_id):
+                return TaskStatus.MISSING
+            if task_id in self._task_queue:
+                return TaskStatus.QUEUED
+            occupied = str(self._task_repo.get_item_value(task_id, "resource_occupied"))
+            if occupied == "1":
+                job_id = self._task_repo.get_item_value(task_id, "job_id")
+                logical_status = self._launcher.get_job_status(job_id) if job_id \
+                    else TaskStatus.FAILED
+                device_result = self._get_device_result(task_id)
+                status = self._combine(task_id, logical_status, device_result)
+                if status in (TaskStatus.SUCCEEDED, TaskStatus.FAILED, TaskStatus.STOPPED):
+                    self._task_repo.set_item_value(task_id, "task_status", status.name)
+                return status
+            stored = self._task_repo.get_item_value(task_id, "task_status")
+            try:
+                return TaskStatus[stored]
+            except (KeyError, TypeError):
+                return TaskStatus.MISSING
+
+    def get_task_queue(self) -> list:
+        return self._task_queue.get_task_ids()
+
+    def change_scheduler(self, name: str) -> bool:
+        try:
+            self._strategy = StrategyFactory.create_strategy(name)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    # --------------------------------------------------------- status fusion
+    def _get_device_result(self, task_id: str) -> Dict[str, Any]:
+        """Phone-side progress via the PhoneMgr client; absent in standalone
+        mode (reference ``task_manager.py:538-576``)."""
+        if self._phone_client is None:
+            return {"is_finished": True, "device_result": []}
+        return self._phone_client.get_device_task_status(task_id)
+
+    def _half_state(self, task_id: str, prefix: str) -> SimHalfState:
+        target_blob = self._task_repo.get_item_value(task_id, f"{prefix}_target")
+        if not target_blob:
+            return SimHalfState(present=False)
+        result_blob = self._task_repo.get_item_value(task_id, f"{prefix}_result")
+        rnd = self._task_repo.get_item_value(task_id, f"{prefix}_round")
+        return SimHalfState(
+            present=True,
+            target=json.loads(target_blob).get(f"{prefix}_target", []),
+            result=json.loads(result_blob).get(f"{prefix}_result", []) if result_blob else [],
+            current_round=int(rnd) if rnd is not None else None,
+            operator_name=self._task_repo.get_item_value(task_id, f"{prefix}_operator"),
+        )
+
+    def _combine(self, task_id: str, logical_status: TaskStatus,
+                 device_result: Dict[str, Any]) -> TaskStatus:
+        blob = self._task_repo.get_item_value(task_id, "total_simulation")
+        if not blob:
+            return TaskStatus.FAILED
+        task_params = json.loads(blob)
+        conditions = calculate_conditions(
+            task_params,
+            self._half_state(task_id, "logical"),
+            self._half_state(task_id, "device"),
+        )
+        return combine_task_status(
+            conditions, logical_status, device_result.get("is_finished", True)
+        )
+
+    # ------------------------------------------------------------ scheduling
+    def schedule_once(self) -> Optional[str]:
+        """One scheduler iteration (reference ``run`` thread body,
+        ``task_manager.py:1053-1069``); returns the launched task id."""
+        with self._lock:
+            queue = self._task_queue.get_task_queue()
+        if not queue:
+            return None
+        available = (
+            self._resource_manager.get_resource()
+            if self._resource_manager is not None
+            else {"logical_simulation": {"cpu": float("inf"), "mem": float("inf")},
+                  "device_simulation": {}}
+        )
+        result = self._strategy.schedule_next_task(queue, available)
+        if result is None:
+            return None
+        task_id = result.task.taskID.taskID
+        with self._lock:
+            self._task_queue.delete(task_id)
+        self._submit_scheduled(result)
+        return task_id
+
+    def _submit_scheduled(self, result: ScheduleResult) -> None:
+        """Freeze -> register deviceflow -> launch (reference
+        ``threading_submit_task``, ``task_manager.py:917-1051``)."""
+        tc = result.task
+        task_id = tc.taskID.taskID
+        repo = self._task_repo
+        if self._resource_manager is not None:
+            req = result.task_request["logical_simulation"]
+            if not self._resource_manager.request_cluster_resource(
+                task_id, tc.userID, req["cpu"], req["mem"]
+            ):
+                repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
+                return
+        if self._deviceflow is not None:
+            uses_flow = any(
+                op.operationBehaviorController.useController
+                for op in tc.operatorFlow.operator
+            )
+            if uses_flow:
+                # Reference DeviceflowResgister (utils_runner.py:630-671).
+                self._deviceflow.register_task(task_id, ["logical_simulation"])
+        try:
+            job_id = self._launcher.submit(
+                lambda stop_event: self._runner_factory(tc, stop_event),
+                job_id=f"job-{task_id}",
+            )
+        except Exception as e:  # noqa: BLE001
+            self.logger.error(task_id=task_id, system_name="TaskMgr",
+                              module_name="submit", message=f"launch failed: {e}")
+            if self._resource_manager is not None:
+                self._resource_manager.release_resource(task_id)
+            repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
+            return
+        repo.set_item_value(task_id, "job_id", job_id)
+        repo.set_item_value(task_id, "task_status", TaskStatus.RUNNING.name)
+        repo.set_item_value(task_id, "resource_occupied", "1")
+        repo.set_item_value(task_id, "submit_task_time", time.strftime("%Y-%m-%d %H:%M:%S"))
+
+    # ------------------------------------------------------- release/interrupt
+    def release_once(self) -> None:
+        """Release finished tasks (reference ``releaseResource`` thread,
+        ``task_manager.py:1071-1148``): job terminal -> release resources,
+        unregister deviceflow once dispatch drained, stamp finish time."""
+        for row in self._task_repo.query_all():
+            if str(row.get("resource_occupied")) != "1":
+                continue
+            task_id = row["task_id"]
+            job_id = row.get("job_id")
+            status = self._launcher.get_job_status(job_id) if job_id else TaskStatus.FAILED
+            if status in (TaskStatus.PENDING, TaskStatus.RUNNING):
+                continue
+            if self._deviceflow is not None:
+                if not self._deviceflow.check_dispatch_finished(task_id):
+                    continue  # retry next cycle (reference :1104-1121)
+                self._deviceflow.unregister_task(task_id)
+            if self._resource_manager is not None:
+                self._resource_manager.release_resource(task_id)
+            final = self.get_task_status(task_id)
+            self._task_repo.set_item_value(task_id, "resource_occupied", "0")
+            self._task_repo.set_item_value(task_id, "task_status", final.name)
+            self._task_repo.set_item_value(
+                task_id, "task_finished_time", time.strftime("%Y-%m-%d %H:%M:%S")
+            )
+
+    def interrupt_once(self, now: Optional[float] = None) -> None:
+        """Watchdog (reference ``interruptTask``, ``task_manager.py:1150-1200``):
+        kill tasks queued or running beyond their timeouts."""
+        now = now if now is not None else time.time()
+        for row in self._task_repo.query_all():
+            task_id = row["task_id"]
+            status = row.get("task_status")
+            if status == TaskStatus.QUEUED.name and row.get("in_queue_time"):
+                queued_at = time.mktime(time.strptime(row["in_queue_time"], "%Y-%m-%d %H:%M:%S"))
+                if now - queued_at > self._interrupt_queue_time:
+                    self.stop_task(task_id)
+            elif status == TaskStatus.RUNNING.name and row.get("submit_task_time"):
+                started_at = time.mktime(
+                    time.strptime(row["submit_task_time"], "%Y-%m-%d %H:%M:%S")
+                )
+                if now - started_at > self._interrupt_running_time:
+                    self.stop_task(task_id)
+
+    # --------------------------------------------------------------- threads
+    def start(self) -> None:
+        """Reference daemon threads (``task_manager.py:79-84``)."""
+        self._stop.clear()
+        for fn, interval, name in (
+            (self.schedule_once, self._schedule_interval, "taskmgr-schedule"),
+            (self.release_once, self._release_interval, "taskmgr-release"),
+            (self.interrupt_once, self._interrupt_interval, "taskmgr-interrupt"),
+        ):
+            t = threading.Thread(
+                target=self._loop, args=(fn, interval), name=name, daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _loop(self, fn, interval: float) -> None:
+        while not self._stop.is_set():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — keep daemons alive
+                self.logger.error(task_id="", system_name="TaskMgr",
+                                  module_name="loop", message=f"{fn.__name__}: {e}")
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()

@@ -1,0 +1,366 @@
+"""Three-stage TaskConfig validation.
+
+Behavior-compatible with the reference validator
+(``ols_core/taskMgr/utils/utils.py:283-829``): type checks, value
+correctness (ASCII-only identifiers, ranges, file extensions, enum
+validity), and cross-field relationship checks (dimension agreement,
+allocation sums, operator DAG inputs referencing earlier operators, resource
+requests covering target data). Returns ``(ok, message)`` where the
+reference returned bare bools with logged messages — the message carries the
+same diagnostic text.
+
+Stage 1 (types) is structurally guaranteed by protobuf in both codebases; it
+survives as a guard that the input *is* a TaskConfig.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import List, Tuple
+
+from olearning_sim_tpu.proto import taskservice_pb2 as pb
+
+_PATH_RE = re.compile(r"^[a-zA-Z0-9/._-]+$")
+
+
+def _ascii(s: str) -> bool:
+    """Reference ``is_in_ascii``: printable ASCII only."""
+    return all(32 <= ord(ch) <= 126 for ch in s)
+
+
+def _ext(s: str, ext: str) -> bool:
+    return s.endswith(ext)
+
+
+def _valid_transfer(value: int) -> bool:
+    try:
+        pb.FileTransferType.Name(value)
+        return True
+    except ValueError:
+        return False
+
+
+class Check(Exception):
+    pass
+
+
+def _req(cond: bool, msg: str) -> None:
+    if not cond:
+        raise Check(msg)
+
+
+def validate_type(request) -> Tuple[bool, str]:
+    """Stage 1 (reference ``validate_type``, ``utils.py:283-399``): with
+    protobuf messages the field types are enforced by construction; assert
+    the message type itself."""
+    if not isinstance(request, pb.TaskConfig):
+        return False, f"expected TaskConfig, got {type(request).__name__}"
+    return True, "Pass"
+
+
+def validate_correctness(request) -> Tuple[bool, str]:
+    """Stage 2 (reference ``validate_correctness``, ``utils.py:401-554``)."""
+    try:
+        _req(request.userID != "", "userID should not be empty")
+        _req(_ascii(request.userID), f"userID={request.userID} contains illegal characters")
+        _req(request.taskID.taskID != "", "taskID should not be empty")
+        _req(_ascii(request.taskID.taskID), f"taskID={request.taskID.taskID} contains illegal characters")
+
+        for i, td in enumerate(request.target.targetData):
+            _req(td.dataName != "", f"The name of No.{i} data in target should not be empty")
+            _req(_ascii(td.dataName), f"data name {td.dataName} contains illegal characters")
+            name = td.dataName
+            if td.dataPath:
+                _req(
+                    _ext(td.dataPath, ".zip") or bool(_PATH_RE.match(td.dataPath)),
+                    f"data_name={name}, dataPath={td.dataPath} should be a .zip or folder path",
+                )
+            _req(_valid_transfer(td.dataTransferType), f"data_name={name}, invalid dataTransferType")
+            _req(_ascii(td.taskType), f"data_name={name}, taskType contains illegal characters")
+            devices = list(td.totalSimulation.deviceTotalSimulation)
+            _req(len(devices) > 0, f"data_name={name}, deviceTotalSimulation must be non-empty")
+            _req(len(devices) == len(set(devices)), f"data_name={name}, deviceTotalSimulation has repeats")
+            _req(all(_ascii(d) for d in devices), f"data_name={name}, device names contain illegal characters")
+            _req(
+                all(n > 0 for n in td.totalSimulation.numTotalSimulation),
+                f"data_name={name}, numTotalSimulation must be > 0",
+            )
+            _req(
+                all(n >= 0 for n in td.totalSimulation.dynamicNumTotalSimulation),
+                f"data_name={name}, dynamicNumTotalSimulation must be >= 0",
+            )
+            _req(
+                all(n >= 0 for n in td.allocation.allocationLogicalSimulation),
+                f"data_name={name}, allocationLogicalSimulation must be >= 0",
+            )
+            _req(
+                all(n >= 0 for n in td.allocation.allocationDeviceSimulation),
+                f"data_name={name}, allocationDeviceSimulation must be >= 0",
+            )
+            rr_devices = list(td.allocation.runningResponse.deviceRunningResponse)
+            _req(all(_ascii(d) for d in rr_devices), f"data_name={name}, runningResponse devices illegal")
+            _req(len(rr_devices) == len(set(rr_devices)), f"data_name={name}, runningResponse devices repeat")
+            _req(
+                all(n >= 0 for n in td.allocation.runningResponse.numRunningResponse),
+                f"data_name={name}, numRunningResponse must be >= 0",
+            )
+        _req(0 <= request.target.priority <= 10,
+             f"target.priority={request.target.priority} should be in range from 0 to 10")
+
+        fs = request.operatorFlow.flowSetting
+        _req(fs.round > 0, f"operatorFlow.flowSetting.round={fs.round} should be larger than 0")
+        for cond in (fs.startCondition, fs.stopCondition):
+            for strat in (cond.logicalSimulationStrategy, cond.deviceSimulationStrategy):
+                _req(_ascii(strat.strategyCondition), "strategyCondition contains illegal characters")
+                _req(strat.waitInterval >= 0, "waitInterval must be >= 0")
+                _req(strat.totalTimeout >= 0, "totalTimeout must be >= 0")
+
+        for i, op in enumerate(request.operatorFlow.operator):
+            _req(op.name != "", f"The name of No.{i} operator should not be empty")
+            _req(_ascii(op.name), f"operator name {op.name} contains illegal characters")
+            _req(" " not in op.name, f"operator name {op.name} includes spaces")
+            obc = op.operationBehaviorController
+            _req(_ascii(obc.strategyBehaviorController), "strategyBehaviorController illegal characters")
+            _req(_ascii(obc.outboundService), "outboundService illegal characters")
+            _req(all(_ascii(x) for x in op.input), f"operator {op.name} input illegal characters")
+            _req(_valid_transfer(op.model.modelTransferType), f"operator {op.name} invalid modelTransferType")
+            _req(_ascii(op.model.modelPath), f"operator {op.name} modelPath illegal characters")
+            _req(_ascii(op.model.modelUpdateStyle), f"operator {op.name} modelUpdateStyle illegal characters")
+            for which, info, code_exts, entry_ext in (
+                ("logical", op.logicalSimulationOperatorInfo, (".zip", "dir"), ".py"),
+                ("device", op.deviceSimulationOperatorInfo, (".apk",), ".apk"),
+            ):
+                _req(_valid_transfer(info.operatorTransferType),
+                     f"operator {op.name} invalid {which} operatorTransferType")
+                if info.operatorCodePath != "":
+                    _req(_ascii(info.operatorCodePath),
+                         f"operator {op.name} {which} operatorCodePath illegal characters")
+                    if which == "logical":
+                        _req(
+                            os.path.isdir(os.path.abspath(info.operatorCodePath))
+                            or _ext(info.operatorCodePath, ".zip")
+                            # TPU-native extension: registry-addressed builtin
+                            # operators need no code archive.
+                            or info.operatorCodePath.startswith("builtin:"),
+                            f"operator {op.name} logical operatorCodePath should be an existing "
+                            f"dir, a .zip, or a builtin: reference",
+                        )
+                    else:
+                        _req(_ext(info.operatorCodePath, ".apk"),
+                             f"operator {op.name} device operatorCodePath should be .apk")
+                if info.operatorEntryFile != "":
+                    _req(_ascii(info.operatorEntryFile),
+                         f"operator {op.name} {which} operatorEntryFile illegal characters")
+                    if which == "logical":
+                        _req(
+                            _ext(info.operatorEntryFile, ".py")
+                            or info.operatorCodePath.startswith("builtin:"),
+                            f"operator {op.name} logical operatorEntryFile should be .py",
+                        )
+                    else:
+                        _req(_ext(info.operatorEntryFile, ".apk"),
+                             f"operator {op.name} device operatorEntryFile should be .apk")
+                if info.operatorParams:
+                    try:
+                        json.loads(info.operatorParams)
+                    except (ValueError, TypeError):
+                        raise Check(f"operator {op.name} {which} operatorParams should be a json string")
+
+        units = list(request.logicalSimulation.computationUnit.devicesUnit)
+        _req(len(units) == len(set(units)), "computationUnit.devicesUnit has repeats")
+        _req(all(_ascii(u) for u in units), "computationUnit.devicesUnit illegal characters")
+        _req(
+            all(s.numCpus >= 1 for s in request.logicalSimulation.computationUnit.unitSetting),
+            "unitSetting.numCpus must be >= 1",
+        )
+        for which, requests in (
+            ("logicalSimulation", request.logicalSimulation.resourceRequestLogicalSimulation),
+            ("deviceSimulation", request.deviceSimulation.resourceRequestDeviceSimulation),
+        ):
+            for i, rr in enumerate(requests):
+                _req(rr.dataNameResourceRequest != "",
+                     f"No.{i} resource_request in {which} name should not be empty")
+                _req(_ascii(rr.dataNameResourceRequest),
+                     f"{which} resource_request name illegal characters")
+                devs = list(rr.deviceResourceRequest)
+                _req(len(devs) == len(set(devs)), f"{which} deviceResourceRequest has repeats")
+                _req(all(_ascii(d) for d in devs), f"{which} deviceResourceRequest illegal characters")
+                _req(all(n >= 0 for n in rr.numResourceRequest),
+                     f"{which} numResourceRequest must be >= 0")
+        return True, "Pass"
+    except Check as e:
+        return False, str(e)
+
+
+def validate_relationship(request) -> Tuple[bool, str]:
+    """Stage 3 (reference ``validate_relationship``, ``utils.py:556-811``)."""
+    try:
+        data_names: List[str] = []
+        for td in request.target.targetData:
+            name = td.dataName
+            data_names.append(name)
+            if td.dataPath:
+                transfer = pb.FileTransferType.Name(td.dataTransferType)
+                if transfer not in ("MINIO", "FILE"):
+                    _req(_ext(td.dataPath, ".zip"),
+                         f"data_name={name}, transfer={transfer}: dataPath must be .zip")
+            devices = list(td.totalSimulation.deviceTotalSimulation)
+            nums = list(td.totalSimulation.numTotalSimulation)
+            dynamic = list(td.totalSimulation.dynamicNumTotalSimulation)
+            _req(len(devices) == len(nums) == len(dynamic),
+                 f"data_name={name}: devices, nums, dynamic_nums must have equal length")
+            _req(all(nums[i] > dynamic[i] for i in range(len(nums))),
+                 f"data_name={name}: nums={nums} must exceed dynamic_nums={dynamic}")
+            rr_devices = list(td.allocation.runningResponse.deviceRunningResponse)
+            _req(set(rr_devices).issubset(devices),
+                 f"data_name={name}: runningResponse devices must be in totalSimulation devices")
+            rr_nums = list(td.allocation.runningResponse.numRunningResponse)
+            _req(len(rr_devices) == len(rr_nums),
+                 f"data_name={name}: runningResponse devices/nums length mismatch")
+            rr_map = dict(zip(rr_devices, rr_nums))
+            rr_reordered = [rr_map.get(d, 0) for d in devices]
+            _req(all(rr_reordered[i] <= nums[i] for i in range(len(nums))),
+                 f"data_name={name}: runningResponse nums exceed totalSimulation nums")
+            if not td.allocation.optimization:
+                alloc_l = list(td.allocation.allocationLogicalSimulation) or [0] * len(nums)
+                alloc_d = list(td.allocation.allocationDeviceSimulation) or [0] * len(nums)
+                _req(len(alloc_l) == len(nums) == len(alloc_d),
+                     f"data_name={name}: allocation lengths must match nums")
+                _req(all(nums[i] == alloc_l[i] + alloc_d[i] for i in range(len(nums))),
+                     f"data_name={name}: logical + device allocation must equal totalSimulation nums")
+                _req(all(alloc_d[i] >= rr_reordered[i] for i in range(len(nums))),
+                     f"data_name={name}: device allocation must cover runningResponse")
+
+        fs = request.operatorFlow.flowSetting
+        for cond in (fs.startCondition, fs.stopCondition):
+            for strat in (cond.logicalSimulationStrategy, cond.deviceSimulationStrategy):
+                _req(strat.waitInterval <= strat.totalTimeout,
+                     "waitInterval in operatorflow should be no larger than totalTimeout")
+
+        seen_ops: List[str] = []
+        for op in request.operatorFlow.operator:
+            if op.operationBehaviorController.useController:
+                _req(op.operationBehaviorController.strategyBehaviorController != "",
+                     f"operator {op.name}: strategyBehaviorController required when useController")
+            if list(op.input):
+                _req(set(op.input).issubset(set(seen_ops)),
+                     f"operator {op.name}: input {list(op.input)} must reference earlier operators")
+            if op.model.useModel:
+                _req(op.model.modelPath != "",
+                     f"operator {op.name}: modelPath required when useModel")
+            code_path = op.logicalSimulationOperatorInfo.operatorCodePath
+            if code_path != "" and os.path.isdir(os.path.abspath(code_path)):
+                _req(
+                    pb.FileTransferType.Name(
+                        op.logicalSimulationOperatorInfo.operatorTransferType
+                    ) == "FILE",
+                    f"operator {op.name}: dir operatorCodePath requires FILE transfer",
+                )
+            _req(
+                not (op.logicalSimulationOperatorInfo.operatorCodePath == ""
+                     and op.deviceSimulationOperatorInfo.operatorCodePath == ""),
+                f"operator {op.name}: operatorCodePath must be set for at least one side",
+            )
+            # Builtin operators are addressed by name and ship no entry file
+            # (TPU-native extension; reference required one, utils.py:671-673).
+            if not op.logicalSimulationOperatorInfo.operatorCodePath.startswith("builtin:"):
+                _req(
+                    not (op.logicalSimulationOperatorInfo.operatorEntryFile == ""
+                         and op.deviceSimulationOperatorInfo.operatorEntryFile == ""),
+                    f"operator {op.name}: operatorEntryFile must be set for at least one side",
+                )
+            seen_ops.append(op.name)
+
+        rr_names = [r.dataNameResourceRequest
+                    for r in request.logicalSimulation.resourceRequestLogicalSimulation]
+        rr_names += [r.dataNameResourceRequest
+                     for r in request.deviceSimulation.resourceRequestDeviceSimulation]
+        _req(set(data_names) == set(rr_names),
+             "resource requests must cover exactly the target data names")
+
+        units = list(request.logicalSimulation.computationUnit.devicesUnit)
+        settings = list(request.logicalSimulation.computationUnit.unitSetting)
+        _req(len(units) == len(settings), "devicesUnit and unitSetting length mismatch")
+        all_devices = [
+            d for td in request.target.targetData
+            for d in td.totalSimulation.deviceTotalSimulation
+        ]
+        _req(set(all_devices).issubset(set(units)),
+             f"all totalSimulation devices {all_devices} must be in computationUnit {units}")
+
+        for rr in request.logicalSimulation.resourceRequestLogicalSimulation:
+            _req(rr.dataNameResourceRequest in data_names,
+                 f"logicalSimulation resource request {rr.dataNameResourceRequest} unknown data")
+            _req(len(rr.deviceResourceRequest) == len(rr.numResourceRequest),
+                 "logicalSimulation resource request devices/nums length mismatch")
+            req_map = dict(zip(rr.deviceResourceRequest, rr.numResourceRequest))
+            for td in request.target.targetData:
+                if td.dataName != rr.dataNameResourceRequest:
+                    continue
+                if not td.allocation.optimization:
+                    alloc_map = dict(zip(
+                        td.totalSimulation.deviceTotalSimulation,
+                        td.allocation.allocationLogicalSimulation,
+                    ))
+                else:
+                    alloc_map = {}
+                for dev, n_req in req_map.items():
+                    n_alloc = alloc_map.get(dev, 0)
+                    if not td.allocation.optimization and n_alloc > 0:
+                        _req(n_req > 0,
+                             f"logicalSimulation {td.dataName}/{dev}: request must be > 0 "
+                             f"when allocation > 0")
+                    else:
+                        _req(n_req >= 0, f"logicalSimulation {td.dataName}/{dev}: bad request")
+
+        for rr in request.deviceSimulation.resourceRequestDeviceSimulation:
+            _req(rr.dataNameResourceRequest in data_names,
+                 f"deviceSimulation resource request {rr.dataNameResourceRequest} unknown data")
+            _req(len(rr.deviceResourceRequest) == len(rr.numResourceRequest),
+                 "deviceSimulation resource request devices/nums length mismatch")
+            req_map = dict(zip(rr.deviceResourceRequest, rr.numResourceRequest))
+            for td in request.target.targetData:
+                if td.dataName != rr.dataNameResourceRequest:
+                    continue
+                rr_map = dict(zip(
+                    td.allocation.runningResponse.deviceRunningResponse,
+                    td.allocation.runningResponse.numRunningResponse,
+                ))
+                if not td.allocation.optimization:
+                    alloc_map = dict(zip(
+                        td.totalSimulation.deviceTotalSimulation,
+                        td.allocation.allocationDeviceSimulation,
+                    ))
+                    for dev, n_alloc in alloc_map.items():
+                        n_req = req_map.get(dev, 0)
+                        n_rr = rr_map.get(dev, 0)
+                        if n_alloc == n_rr:
+                            _req(n_req >= n_rr,
+                                 f"deviceSimulation {td.dataName}/{dev}: request must cover "
+                                 f"runningResponse")
+                        else:
+                            _req(n_req >= 1 and n_req > n_rr,
+                                 f"deviceSimulation {td.dataName}/{dev}: request must exceed "
+                                 f"runningResponse when allocation > runningResponse")
+                else:
+                    for dev, n_req in req_map.items():
+                        n_rr = rr_map.get(dev, 0)
+                        if n_rr > 0:
+                            _req(n_req > n_rr,
+                                 f"deviceSimulation {td.dataName}/{dev}: request must exceed "
+                                 f"runningResponse")
+        return True, "Pass"
+    except Check as e:
+        return False, str(e)
+
+
+def validate_task_parameters(request) -> Tuple[bool, str]:
+    """Reference ``validate_task_parameters`` (``utils.py:813-829``): run the
+    three stages in order, first failure wins."""
+    for stage in (validate_type, validate_correctness, validate_relationship):
+        ok, msg = stage(request)
+        if not ok:
+            return False, msg
+    return True, "Pass"

