@@ -63,6 +63,10 @@ def _operator_specs(tc: pb.TaskConfig) -> list:
     specs = []
     for op in tc.operatorFlow.operator:
         info = op.logicalSimulationOperatorInfo
+        if info.operatorCodePath == "":
+            # Device-only operator: belongs to the phone half, nothing for
+            # the TPU engine to run (validation allows this shape).
+            continue
         if not info.operatorCodePath.startswith(BUILTIN_PREFIX):
             raise ValueError(
                 f"operator {op.name}: only builtin: operators are supported by the "
@@ -128,7 +132,14 @@ def build_runner_from_taskconfig(
     populations = []
     for td in tc.target.targetData:
         devices = list(td.totalSimulation.deviceTotalSimulation)
-        nums = [int(n) for n in td.totalSimulation.numTotalSimulation]
+        # The logical half simulates only its allocated share of device-
+        # rounds; the remainder belongs to real phones (hybrid split,
+        # reference JobSubmitter projection utils_runner.py:498-561).
+        alloc = [int(a) for a in td.allocation.allocationLogicalSimulation]
+        if alloc and any(a > 0 for a in alloc):
+            nums = alloc
+        else:
+            nums = [int(n) for n in td.totalSimulation.numTotalSimulation]
         dynamic = [int(n) for n in td.totalSimulation.dynamicNumTotalSimulation]
         if not dynamic:
             dynamic = [0] * len(nums)

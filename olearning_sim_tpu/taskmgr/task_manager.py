@@ -36,6 +36,16 @@ from olearning_sim_tpu.taskmgr.validation import validate_task_parameters
 from olearning_sim_tpu.utils.logging import Logger
 
 
+def _logical_nums(td) -> list:
+    """The logical half's share of device-rounds: the explicit allocation when
+    present, else the full totalSimulation nums (reference JobSubmitter
+    projection, ``utils_runner.py:498-561``)."""
+    alloc = list(td.allocation.allocationLogicalSimulation)
+    if alloc and any(a > 0 for a in alloc):
+        return alloc
+    return list(td.totalSimulation.numTotalSimulation)
+
+
 def _total_simulation_entry(tc: pb.TaskConfig) -> Dict[str, Any]:
     """The persisted ``total_simulation`` blob consumed by the status
     calculus (reference ``task_manager.py:217-244``)."""
@@ -72,6 +82,7 @@ class TaskManager:
         interrupt_queue_time: float = 3600.0,
         interrupt_running_time: float = 172800.0,
         auto_create_rows: bool = True,
+        cost_model=None,
         logger: Optional[Logger] = None,
     ):
         """``runner_factory(task_config, task_repo, deviceflow, stop_event)``
@@ -92,6 +103,9 @@ class TaskManager:
         self._interrupt_queue_time = interrupt_queue_time
         self._interrupt_running_time = interrupt_running_time
         self._auto_create_rows = auto_create_rows
+        from olearning_sim_tpu.taskmgr.hybrid import CostModel
+
+        self._cost_model = cost_model if cost_model is not None else CostModel()
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads = []
@@ -101,22 +115,38 @@ class TaskManager:
     def _recover(self) -> None:
         """Boot recovery (reference ``get_taskqueue_from_repo``,
         ``task_manager.py:89-155``): re-queue QUEUED rows ordered by
-        in_queue_time; re-adopt rows whose resources are still frozen."""
+        in_queue_time; rows whose resources were frozen at crash time have
+        lost their in-process job, so they are released and failed (the
+        reference re-adopts them into the release loop, which stops and
+        releases them the same way)."""
         rows = sorted(
             (r for r in self._task_repo.query_all() if r.get("task_params")),
             key=lambda r: r.get("in_queue_time") or "",
         )
         for row in rows:
             status = row.get("task_status")
+            task_id = row.get("task_id", "")
             if status == TaskStatus.QUEUED.name:
                 try:
                     tc = json2taskconfig(row["task_params"])
                     self._task_queue.add(tc)
                 except Exception as e:  # noqa: BLE001
                     self.logger.error(
-                        task_id=row.get("task_id", ""), system_name="TaskMgr",
+                        task_id=task_id, system_name="TaskMgr",
                         module_name="recover", message=f"requeue failed: {e}",
                     )
+            elif str(row.get("resource_occupied")) == "1":
+                self.logger.error(
+                    task_id=task_id, system_name="TaskMgr", module_name="recover",
+                    message="engine job lost across restart; releasing and failing",
+                )
+                if self._resource_manager is not None:
+                    self._resource_manager.release_resource(task_id)
+                self._task_repo.set_item_value(task_id, "resource_occupied", "0")
+                self._task_repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
+                self._task_repo.set_item_value(
+                    task_id, "task_finished_time", time.strftime("%Y-%m-%d %H:%M:%S")
+                )
 
     def _default_runner_factory(self, tc, stop_event):
         from olearning_sim_tpu.engine.task_bridge import build_runner_from_taskconfig
@@ -176,7 +206,12 @@ class TaskManager:
                 self._launcher.stop_job(job_id)
                 self._task_repo.set_item_value(task_id, "task_status", TaskStatus.STOPPED.name)
                 return True
-            return self._task_repo.has_task(task_id)
+            if self._task_repo.has_task(task_id):
+                # Between queue removal and launch: mark STOPPED so the
+                # in-flight _submit_scheduled aborts before launching.
+                self._task_repo.set_item_value(task_id, "task_status", TaskStatus.STOPPED.name)
+                return True
+            return False
 
     def get_task_status(self, task_id: str) -> TaskStatus:
         """Status fusion (reference ``get_task_status``,
@@ -268,8 +303,10 @@ class TaskManager:
             return None
         task_id = result.task.taskID.taskID
         with self._lock:
-            self._task_queue.delete(task_id)
-        self._submit_scheduled(result)
+            if not self._task_queue.delete(task_id):
+                # stop_task removed it between snapshot and here
+                return None
+            self._submit_scheduled(result)
         return task_id
 
     def _submit_scheduled(self, result: ScheduleResult) -> None:
@@ -278,6 +315,37 @@ class TaskManager:
         tc = result.task
         task_id = tc.taskID.taskID
         repo = self._task_repo
+        if any(td.allocation.optimization for td in tc.target.targetData):
+            # Hybrid ILP allocation before launch (reference
+            # HybridOptimizer.fix_data_parameters, utils_runner.py:29-51).
+            from olearning_sim_tpu.taskmgr.hybrid import fix_data_parameters
+
+            try:
+                fix_data_parameters(tc, self._cost_model)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(task_id=task_id, system_name="TaskMgr",
+                                  module_name="hybrid", message=f"allocation failed: {e}")
+                repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
+                return
+        if repo.get_item_value(task_id, "task_status") == TaskStatus.STOPPED.name:
+            return  # stopped while being scheduled
+        # Persist the (possibly allocator-mutated) config and the logical
+        # half's target BEFORE launch, so status fusion never sees an
+        # occupied task with a vacuously-absent logical half.
+        repo.set_item_value(task_id, "task_params", json.dumps(taskconfig2json(tc)))
+        logical_target = [
+            {
+                "name": td.dataName,
+                "simulation_target": {
+                    "devices": list(td.totalSimulation.deviceTotalSimulation),
+                    "nums": _logical_nums(td),
+                },
+            }
+            for td in tc.target.targetData
+        ]
+        repo.set_item_value(
+            task_id, "logical_target", json.dumps({"logical_target": logical_target})
+        )
         if self._resource_manager is not None:
             req = result.task_request["logical_simulation"]
             if not self._resource_manager.request_cluster_resource(
@@ -329,7 +397,11 @@ class TaskManager:
                 self._deviceflow.unregister_task(task_id)
             if self._resource_manager is not None:
                 self._resource_manager.release_resource(task_id)
-            final = self.get_task_status(task_id)
+            if status == TaskStatus.MISSING:
+                # job record lost (shouldn't happen in-process): fail loudly
+                final = TaskStatus.FAILED
+            else:
+                final = self.get_task_status(task_id)
             self._task_repo.set_item_value(task_id, "resource_occupied", "0")
             self._task_repo.set_item_value(task_id, "task_status", final.name)
             self._task_repo.set_item_value(

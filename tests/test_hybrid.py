@@ -1,0 +1,92 @@
+"""Hybrid min-makespan allocator vs a brute-force oracle
+(reference ``utils_runner.py:939-1022`` semantics)."""
+
+import math
+
+import pytest
+
+from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+from olearning_sim_tpu.taskmgr.hybrid import (
+    CostModel,
+    _makespan,
+    _solve_brute,
+    auto_allocation_hybrid_task,
+    fix_data_parameters,
+)
+from tests.test_taskmgr import make_task_json
+
+
+def test_degenerate_classes():
+    # no logical units -> all device; no phones -> all logical
+    alloc_l, alloc_d = auto_allocation_hybrid_task(
+        {"N": [100, 50], "q": [0, 0], "f": [0, 4], "k": [1, 1], "m": [10, 0]}
+    )
+    assert alloc_l == [0, 50]
+    assert alloc_d == [100, 0]
+
+
+def test_milp_matches_brute_force():
+    cm = CostModel(alpha=3.5, beta=0.14, lam=8.808)
+    cases = [
+        {"N": [100], "q": [0], "f": [8], "k": [1], "m": [5]},
+        {"N": [60, 80], "q": [5, 0], "f": [4, 2], "k": [1, 2], "m": [3, 6]},
+        {"N": [200], "q": [20], "f": [16], "k": [1], "m": [50]},
+    ]
+    for data in cases:
+        alloc_l, _ = auto_allocation_hybrid_task(dict(data), cm)
+        brute = _solve_brute(data["N"], data["q"], data["f"], data["k"], data["m"], cm)
+        # The MILP minimizes the GLOBAL makespan (max over classes) like the
+        # reference; the per-class brute oracle is one global optimum.
+        def global_makespan(xs):
+            return max(
+                _makespan(x, N, q, f, k, m, cm)
+                for x, N, q, f, k, m in zip(
+                    xs, data["N"], data["q"], data["f"], data["k"], data["m"]
+                )
+            )
+        assert global_makespan(alloc_l) <= global_makespan(brute) + 1e-9
+
+
+def test_fast_logical_takes_everything():
+    # TPU-speed alpha: logical side is so fast the whole load goes logical
+    # (phone lambda alone costs 8.8s)
+    cm = CostModel.tpu_measured(device_rounds_per_sec=500.0)
+    alloc_l, alloc_d = auto_allocation_hybrid_task(
+        {"N": [1000], "q": [0], "f": [8], "k": [1], "m": [100]}, cm
+    )
+    assert alloc_l == [1000]
+    assert alloc_d == [0]
+
+
+def test_slow_logical_prefers_phones():
+    cm = CostModel(alpha=100.0, beta=0.1, lam=1.0)
+    alloc_l, alloc_d = auto_allocation_hybrid_task(
+        {"N": [100], "q": [0], "f": [1], "k": [1], "m": [50]}, cm
+    )
+    assert alloc_d[0] > alloc_l[0]
+
+
+def test_running_response_reserved_for_phones():
+    # q rounds are pinned to phones: x is bounded by N - q
+    alloc_l, alloc_d = auto_allocation_hybrid_task(
+        {"N": [100], "q": [40], "f": [8], "k": [1], "m": [10]},
+        CostModel.tpu_measured(1000.0),
+    )
+    assert alloc_l[0] == 60
+    assert alloc_d[0] == 40
+
+
+def test_fix_data_parameters_fills_allocations():
+    js = make_task_json("hybrid_task")
+    td = js["target"]["data"][0]
+    td["allocation"]["optimization"] = True
+    td["allocation"]["logical_simulation"] = []
+    td["allocation"]["device_simulation"] = []
+    js["device_simulation"]["resource_request"] = [
+        {"name": "data_0", "devices": ["high"], "num_request": [5]}
+    ]
+    tc = json2taskconfig(js)
+    fix_data_parameters(tc, CostModel.tpu_measured(1000.0))
+    td_pb = tc.target.targetData[0]
+    assert list(td_pb.allocation.allocationLogicalSimulation) == [24]
+    assert list(td_pb.allocation.allocationDeviceSimulation) == [0]
