@@ -1,5 +1,9 @@
-from olearning_sim_tpu.engine.client_data import ClientDataset, make_synthetic_dataset
-from olearning_sim_tpu.engine.algorithms import Algorithm, fedavg, fedprox, fedadam
+from olearning_sim_tpu.engine.client_data import (
+    ClientDataset,
+    make_synthetic_dataset,
+    make_synthetic_text_dataset,
+)
+from olearning_sim_tpu.engine.algorithms import Algorithm, fedavg, fedprox, fedadam, ditto
 from olearning_sim_tpu.engine.fedcore import (
     FedCore,
     RoundMetrics,
@@ -14,8 +18,10 @@ __all__ = [
     "RoundMetrics",
     "ServerState",
     "build_fedcore",
+    "ditto",
     "fedavg",
     "fedprox",
     "fedadam",
     "make_synthetic_dataset",
+    "make_synthetic_text_dataset",
 ]

@@ -156,6 +156,74 @@ def make_synthetic_dataset(
     )
 
 
+def make_synthetic_text_dataset(
+    seed: int,
+    num_clients: int,
+    n_local: int,
+    seq_len: int,
+    num_classes: int = 2,
+    vocab_size: int = 30522,
+    dirichlet_alpha: Optional[float] = None,
+    signal_frac: float = 0.5,
+    num_samples_range: Optional[Tuple[int, int]] = None,
+) -> ClientDataset:
+    """Learnable synthetic token population for the text family (Sent140
+    stand-in). Each class owns a token band; a ``signal_frac`` fraction of each
+    sequence is drawn from the class band, the rest uniformly — so an
+    embedding-pool probe can learn the label. Token 0 is reserved for padding.
+    """
+    rng = np.random.default_rng([seed, 0x7E87])
+    if dirichlet_alpha is None:
+        probs = np.full((num_clients, num_classes), 1.0 / num_classes)
+    else:
+        probs = rng.dirichlet([dirichlet_alpha] * num_classes, size=num_clients)
+
+    if num_samples_range is None:
+        num_samples = np.full(num_clients, n_local, np.int32)
+    else:
+        lo, hi = num_samples_range
+        num_samples = rng.integers(lo, hi + 1, size=num_clients).astype(np.int32)
+        num_samples = np.minimum(num_samples, n_local)
+
+    band = (vocab_size - 1) // num_classes
+    y = np.empty((num_clients, n_local), np.int32)
+    for c in range(num_clients):
+        y[c] = rng.choice(num_classes, size=n_local, p=probs[c])
+    uniform = rng.integers(1, vocab_size, size=(num_clients, n_local, seq_len))
+    in_band = 1 + y[..., None] * band + rng.integers(
+        0, max(band, 1), size=(num_clients, n_local, seq_len)
+    )
+    use_band = rng.random((num_clients, n_local, seq_len)) < signal_frac
+    x = np.where(use_band, in_band, uniform).astype(np.int32)
+
+    return ClientDataset(
+        x=x,
+        y=y,
+        num_samples=num_samples,
+        client_uid=np.arange(num_clients, dtype=np.int32),
+        weight=num_samples.astype(np.float32),
+        num_real_clients=num_clients,
+    )
+
+
+def make_central_text_eval_set(
+    seed: int,
+    n: int,
+    seq_len: int,
+    num_classes: int = 2,
+    vocab_size: int = 30522,
+    signal_frac: float = 0.5,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Held-out token eval set from the same band distribution (IID)."""
+    rng = np.random.default_rng([seed, 0x7E88])
+    band = (vocab_size - 1) // num_classes
+    y = rng.integers(0, num_classes, size=n).astype(np.int32)
+    uniform = rng.integers(1, vocab_size, size=(n, seq_len))
+    in_band = 1 + y[:, None] * band + rng.integers(0, max(band, 1), size=(n, seq_len))
+    use_band = rng.random((n, seq_len)) < signal_frac
+    return np.where(use_band, in_band, uniform).astype(np.int32), y
+
+
 def _class_means(seed: int, num_classes: int, feat_dim: int, class_sep: float) -> np.ndarray:
     """Class-mean vectors shared by train population and eval set. Drawn from
     a dedicated RNG so train/eval distributions stay correlated regardless of

@@ -371,7 +371,7 @@ def build_fedcore(
         return model.apply({"params": params}, x)
 
     def init_params_fn(rng):
-        dummy = jnp.zeros((1,) + in_shape, jnp.float32)
+        dummy = jnp.zeros((1,) + in_shape, spec.input_dtype)
         return model.init(rng, dummy)["params"]
 
     return FedCore(apply_fn, init_params_fn, algorithm, plan, config)

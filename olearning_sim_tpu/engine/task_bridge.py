@@ -34,7 +34,9 @@ import numpy as np
 from olearning_sim_tpu.engine.algorithms import from_config as algorithm_from_config
 from olearning_sim_tpu.engine.client_data import (
     make_central_eval_set,
+    make_central_text_eval_set,
     make_synthetic_dataset,
+    make_synthetic_text_dataset,
 )
 from olearning_sim_tpu.engine.fedcore import FedCoreConfig, build_fedcore
 from olearning_sim_tpu.engine.runner import (
@@ -122,12 +124,24 @@ def build_runner_from_taskconfig(
         input_shape=input_shape,
     )
 
-    syn = data_cfg.get("synthetic", {})
-    num_classes = int(syn.get("num_classes", 10))
-    if input_shape is None:
-        from olearning_sim_tpu.models import get_model
+    from olearning_sim_tpu.models import get_model
 
-        input_shape = get_model(model_cfg.get("name", "mlp2")).example_input_shape
+    spec = get_model(model_cfg.get("name", "mlp2"))
+    syn = data_cfg.get("synthetic", {})
+    num_classes = int(syn.get("num_classes", spec.num_classes))
+    if input_shape is None:
+        input_shape = spec.example_input_shape
+    # Token models (int input dtype) get the text population; everything else
+    # the Gaussian-blob image/feature population.
+    is_text = np.issubdtype(np.dtype(spec.input_dtype), np.integer)
+    vocab_size = int(
+        syn.get(
+            "vocab_size",
+            (model_cfg.get("overrides") or {}).get(
+                "vocab_size", spec.defaults.get("vocab_size", 30522)
+            ),
+        )
+    )
 
     populations = []
     for td in tc.target.targetData:
@@ -144,15 +158,27 @@ def build_runner_from_taskconfig(
         if not dynamic:
             dynamic = [0] * len(nums)
         num_clients = sum(nums)
-        ds = make_synthetic_dataset(
-            seed=int(syn.get("seed", 0)),
-            num_clients=num_clients,
-            n_local=int(syn.get("n_local", 20)),
-            input_shape=input_shape,
-            num_classes=num_classes,
-            dirichlet_alpha=syn.get("dirichlet_alpha"),
-            class_sep=float(syn.get("class_sep", 2.0)),
-        ).pad_for(plan, cfg.block_clients).place(plan)
+        if is_text:
+            ds = make_synthetic_text_dataset(
+                seed=int(syn.get("seed", 0)),
+                num_clients=num_clients,
+                n_local=int(syn.get("n_local", 20)),
+                seq_len=int(input_shape[0]),
+                num_classes=num_classes,
+                vocab_size=vocab_size,
+                dirichlet_alpha=syn.get("dirichlet_alpha"),
+            )
+        else:
+            ds = make_synthetic_dataset(
+                seed=int(syn.get("seed", 0)),
+                num_clients=num_clients,
+                n_local=int(syn.get("n_local", 20)),
+                input_shape=input_shape,
+                num_classes=num_classes,
+                dirichlet_alpha=syn.get("dirichlet_alpha"),
+                class_sep=float(syn.get("class_sep", 2.0)),
+            )
+        ds = ds.pad_for(plan, cfg.block_clients).place(plan)
         cls = np.zeros(ds.num_clients, int)
         start = 0
         for ci, n in enumerate(nums):
@@ -160,10 +186,16 @@ def build_runner_from_taskconfig(
             start += n
         eval_data = None
         if data_cfg.get("eval_n"):
-            eval_data = make_central_eval_set(
-                int(syn.get("seed", 0)), int(data_cfg["eval_n"]), input_shape,
-                num_classes, class_sep=float(syn.get("class_sep", 2.0)),
-            )
+            if is_text:
+                eval_data = make_central_text_eval_set(
+                    int(syn.get("seed", 0)), int(data_cfg["eval_n"]),
+                    int(input_shape[0]), num_classes, vocab_size=vocab_size,
+                )
+            else:
+                eval_data = make_central_eval_set(
+                    int(syn.get("seed", 0)), int(data_cfg["eval_n"]), input_shape,
+                    num_classes, class_sep=float(syn.get("class_sep", 2.0)),
+                )
         populations.append(
             DataPopulation(
                 name=td.dataName,

@@ -15,6 +15,7 @@ import dataclasses
 from typing import Any, Callable, Dict, Tuple
 
 import flax.linen as nn
+import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
@@ -25,6 +26,8 @@ class ModelSpec:
     example_input_shape: Tuple[int, ...]
     num_classes: int
     defaults: Dict[str, Any]
+    # Input element dtype (np.int32 for token models, np.float32 otherwise).
+    input_dtype: Any = np.float32
 
     def build(self, **overrides) -> nn.Module:
         kwargs = dict(self.defaults)

@@ -1,0 +1,114 @@
+"""ResNet family (BASELINE config 3: FedProx + ResNet-18 on FEMNIST, 3.5k
+clients with a churn trace).
+
+Design notes (TPU-first):
+- GroupNorm instead of BatchNorm: batch statistics are per-client minibatch
+  state that does not average meaningfully under FedAvg, and running stats
+  would be extra per-client carry inside the vmapped local loop. GroupNorm is
+  stateless, fuses well under XLA, and is the standard choice in FL ResNets.
+- bfloat16 compute / fp32 logits, matching the rest of the zoo (MXU-friendly).
+- FEMNIST default stem: 28x28x1 inputs, 62 classes (digits+upper+lower).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from olearning_sim_tpu.models.registry import ModelSpec, register_model
+
+
+class ResidualBlock(nn.Module):
+    """Basic (non-bottleneck) residual block, 3x3 + 3x3, GroupNorm."""
+
+    features: int
+    strides: int = 1
+    groups: int = 8
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(
+            self.features, (3, 3), strides=(self.strides, self.strides),
+            padding="SAME", use_bias=False, dtype=self.dtype,
+        )(x)
+        y = nn.GroupNorm(num_groups=min(self.groups, self.features), dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.features, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype
+        )(y)
+        y = nn.GroupNorm(num_groups=min(self.groups, self.features), dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, dtype=self.dtype,
+            )(residual)
+            residual = nn.GroupNorm(
+                num_groups=min(self.groups, self.features), dtype=self.dtype
+            )(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet-18-shaped network: stem + 4 stages x ``blocks_per_stage`` basic
+    blocks + global average pool + fp32 classifier head.
+
+    For small inputs (<=32 px, e.g. FEMNIST/CIFAR) the stem is a 3x3 conv with
+    no max-pool, the standard small-image ResNet variant; for larger inputs it
+    uses the 7x7/2 + maxpool ImageNet stem.
+    """
+
+    stage_features: Sequence[int] = (64, 128, 256, 512)
+    blocks_per_stage: Sequence[int] = (2, 2, 2, 2)
+    num_classes: int = 62
+    groups: int = 8
+    small_inputs: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = nn.Conv(
+                self.stage_features[0], (3, 3), padding="SAME", use_bias=False,
+                dtype=self.dtype,
+            )(x)
+        else:
+            x = nn.Conv(
+                self.stage_features[0], (7, 7), strides=(2, 2), padding="SAME",
+                use_bias=False, dtype=self.dtype,
+            )(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.GroupNorm(
+            num_groups=min(self.groups, self.stage_features[0]), dtype=self.dtype
+        )(x)
+        x = nn.relu(x)
+        for stage, (feats, nblocks) in enumerate(
+            zip(self.stage_features, self.blocks_per_stage)
+        ):
+            for block in range(nblocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = ResidualBlock(
+                    feats, strides=strides, groups=self.groups, dtype=self.dtype
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+register_model(
+    ModelSpec(
+        name="resnet18",
+        builder=ResNet,
+        example_input_shape=(28, 28, 1),
+        num_classes=62,
+        defaults={
+            "stage_features": (64, 128, 256, 512),
+            "blocks_per_stage": (2, 2, 2, 2),
+            "num_classes": 62,
+            "small_inputs": True,
+        },
+    )
+)

@@ -1,0 +1,118 @@
+"""Text-transformer family (BASELINE config 4: FedAdam + DistilBERT on
+Sent140, 10k clients with an access-spike trace).
+
+DistilBERT-shaped encoder: 6 layers, width 768, 12 heads, GELU FFN 3072,
+learned positional embeddings, post-LN residuals — re-specified from the
+public DistilBERT geometry, not ported (the reference keeps models in user
+operator code; SURVEY.md section 2.6). Token inputs are int32; padding id 0 is
+masked out of attention and pooling. bfloat16 compute, fp32 head.
+
+``attention_impl`` selects the attention kernel: ``"dense"`` (XLA fused
+attention) or ``"ring"`` (sequence-parallel ring attention over the mesh's
+``sp`` axis — see ``olearning_sim_tpu/parallel/ring_attention.py``) for
+sequences too long for one device's HBM.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from olearning_sim_tpu.models.registry import ModelSpec, register_model
+
+
+class TransformerBlock(nn.Module):
+    width: int
+    heads: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        # pad_mask: [B, L] bool, True = real token.
+        if self.attention_impl == "ring":
+            try:
+                from olearning_sim_tpu.parallel.ring_attention import RingSelfAttention
+            except ImportError as e:
+                raise NotImplementedError(
+                    "attention_impl='ring' requires olearning_sim_tpu.parallel."
+                    "ring_attention (sequence-parallel ring attention); use "
+                    "'dense' on builds without it"
+                ) from e
+
+            y = RingSelfAttention(
+                num_heads=self.heads, dtype=self.dtype
+            )(x, pad_mask)
+        else:
+            attn_mask = nn.make_attention_mask(pad_mask, pad_mask, dtype=self.dtype)
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=self.heads, dtype=self.dtype, deterministic=True
+            )(x, x, mask=attn_mask)
+        x = nn.LayerNorm(dtype=self.dtype)(x + y)  # post-LN, BERT-style
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(self.width, dtype=self.dtype)(y)
+        return nn.LayerNorm(dtype=self.dtype)(x + y)
+
+
+class TextTransformer(nn.Module):
+    vocab_size: int = 30522
+    max_len: int = 128
+    width: int = 768
+    depth: int = 6
+    heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 2
+    pad_id: int = 0
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, tokens):
+        # tokens: [B, L] int32.
+        pad_mask = tokens != self.pad_id
+        emb = nn.Embed(
+            self.vocab_size, self.width,
+            embedding_init=nn.initializers.normal(stddev=0.02),
+            param_dtype=jnp.float32,
+        )(tokens)
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (1, self.max_len, self.width),
+            jnp.float32,
+        )
+        x = (emb + pos[:, : tokens.shape[1]]).astype(self.dtype)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        for _ in range(self.depth):
+            x = TransformerBlock(
+                self.width, self.heads, self.mlp_dim, self.dtype,
+                self.attention_impl,
+            )(x, pad_mask)
+        # Mean-pool over real tokens (robust when no CLS convention exists in
+        # the synthetic/Sent140 tokenization).
+        m = pad_mask[..., None].astype(jnp.float32)
+        pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(pooled)
+
+
+register_model(
+    ModelSpec(
+        name="distilbert",
+        builder=TextTransformer,
+        example_input_shape=(64,),
+        num_classes=2,
+        defaults={
+            "vocab_size": 30522,
+            "max_len": 64,
+            "width": 768,
+            "depth": 6,
+            "heads": 12,
+            "mlp_dim": 3072,
+            "num_classes": 2,
+        },
+        input_dtype=np.int32,
+    )
+)

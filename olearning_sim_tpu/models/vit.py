@@ -1,0 +1,90 @@
+"""Vision Transformer family (BASELINE config 5: Ditto + ViT-Tiny on
+CIFAR-100, 10k clients with heterogeneous compute profiles).
+
+ViT-Tiny: patch 4 (for 32x32 inputs), width 192, depth 12, 3 heads — the
+standard Ti geometry scaled to CIFAR patching. All matmuls in bfloat16 (MXU),
+fp32 classifier head. Deterministic (no dropout) so the vmapped local loop
+needs no per-client dropout RNG plumbing; FL regularization comes from the
+algorithm (prox terms), not dropout.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from olearning_sim_tpu.models.registry import ModelSpec, register_model
+
+
+class EncoderBlock(nn.Module):
+    width: int
+    heads: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype, deterministic=True
+        )(y, y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.width, dtype=self.dtype)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    patch: int = 4
+    width: int = 192
+    depth: int = 12
+    heads: int = 3
+    mlp_dim: int = 768
+    num_classes: int = 100
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, _ = x.shape
+        x = x.astype(self.dtype)
+        # Patchify as a strided conv — XLA lowers this straight onto the MXU.
+        x = nn.Conv(
+            self.width, (self.patch, self.patch),
+            strides=(self.patch, self.patch), padding="VALID", dtype=self.dtype,
+        )(x)
+        x = x.reshape(b, -1, self.width)
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, self.width), jnp.float32
+        ).astype(self.dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.width)), x], axis=1)
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.width),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.depth):
+            x = EncoderBlock(self.width, self.heads, self.mlp_dim, self.dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x[:, 0])
+
+
+register_model(
+    ModelSpec(
+        name="vit_tiny",
+        builder=ViT,
+        example_input_shape=(32, 32, 3),
+        num_classes=100,
+        defaults={
+            "patch": 4,
+            "width": 192,
+            "depth": 12,
+            "heads": 3,
+            "mlp_dim": 768,
+            "num_classes": 100,
+        },
+    )
+)
