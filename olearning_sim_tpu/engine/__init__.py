@@ -6,6 +6,7 @@ from olearning_sim_tpu.engine.client_data import (
 from olearning_sim_tpu.engine.algorithms import Algorithm, fedavg, fedprox, fedadam, ditto
 from olearning_sim_tpu.engine.fedcore import (
     FedCore,
+    PersonalState,
     RoundMetrics,
     ServerState,
     build_fedcore,
@@ -15,6 +16,7 @@ __all__ = [
     "Algorithm",
     "ClientDataset",
     "FedCore",
+    "PersonalState",
     "RoundMetrics",
     "ServerState",
     "build_fedcore",

@@ -67,6 +67,18 @@ class RoundMetrics(struct.PyTreeNode):
     # the success signal replacing subprocess exit codes
     # (``utils_run_task.py:490-494``).
     client_loss: jnp.ndarray
+    # Weight-averaged Ditto personal-branch loss (0 when not personalized).
+    personal_loss: jnp.ndarray = struct.field(default_factory=lambda: jnp.float32(0.0))
+
+
+class PersonalState(struct.PyTreeNode):
+    """Ditto per-client personalized parameters: every leaf has a leading
+    client axis [C, ...] sharded over ``dp`` — the rebuild's answer to the
+    'per-client optimizer state at 10k clients' memory plan (SURVEY.md
+    section 7 hard parts): state lives sharded across devices and is updated
+    in place (donated) each round."""
+
+    params: Any
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +89,9 @@ class FedCoreConfig:
     # (activations scale with block_clients * batch_size, not population size).
     block_clients: int = 64
     eval_batch_size: int = 1024
+    # Storage dtype for Ditto per-client personal params; None = same as the
+    # global params. jnp.bfloat16 halves resident HBM at 10k-client scale.
+    personal_dtype: Any = None
 
 
 def _to_varying(tree, axis: str):
@@ -112,11 +127,6 @@ class FedCore:
         plan: MeshPlan,
         config: FedCoreConfig = FedCoreConfig(),
     ):
-        if algorithm.personalized:
-            raise NotImplementedError(
-                "Ditto-style personalization lands with the personalized state "
-                "container; use fedavg/fedprox/fedadam here for now."
-            )
         self.apply_fn = apply_fn
         self.init_params_fn = init_params_fn
         self.algorithm = algorithm
@@ -124,6 +134,7 @@ class FedCore:
         self.config = config
         self._round_step = self._build_round_step()
         self._evaluate = self._build_evaluate()
+        self._evaluate_personal = None  # built on first use
 
     # ------------------------------------------------------------------ init
     def init_state(self, rng: jax.Array) -> ServerState:
@@ -139,29 +150,18 @@ class FedCore:
         return jax.device_put(state, self.plan.replicated())
 
     # ------------------------------------------------------- local training
-    def _local_train(self, global_params, x, y, num_samples, num_steps, uid,
-                     base_key, round_idx):
-        """One client's local training: masked lax.scan over SGD steps.
-
-        Per-client RNG stream: fold_in(fold_in(base_key, uid), round) — stable
-        under any resharding of clients to devices, which is what makes the
-        accuracy-parity claim reproducible (SURVEY.md section 7 hard parts).
+    def _masked_sgd(self, params0, opt_state0, x, y, num_samples, steps_eff,
+                    key, loss_fn, grad_transform=None, varying_init=False):
+        """Masked local-SGD loop shared by the global and Ditto branches:
+        step ``i`` samples a minibatch from the valid prefix, applies the
+        local optimizer, and is a no-op when ``i >= steps_eff``. Returns
+        (final_params, mean_loss) with NaN loss for zero-step clients ("no
+        work performed" must not read as success downstream — finiteness is
+        the success signal replacing subprocess exit codes).
         """
         cfg = self.config
         alg = self.algorithm
-        key = jax.random.fold_in(jax.random.fold_in(base_key, uid), round_idx)
-        opt_state = alg.local_optimizer.init(global_params)
         n = jnp.maximum(num_samples, 1)
-        # The scan length is static; clamp so a larger requested step count is
-        # an explicit cap, and metrics divide by the steps actually run.
-        steps_eff = jnp.minimum(num_steps, cfg.max_local_steps)
-
-        def loss_fn(p, xb, yb):
-            logits = self.apply_fn(p, xb)
-            loss = optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
-            if alg.prox_mu:
-                loss = loss + 0.5 * alg.prox_mu * _tree_l2_sq(p, global_params)
-            return loss
 
         def step(carry, i):
             params, opt_state = carry
@@ -170,26 +170,94 @@ class FedCore:
             xb = jnp.take(x, idx, axis=0)
             yb = jnp.take(y, idx, axis=0)
             loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+            if grad_transform is not None:
+                grads = grad_transform(grads, params)
             updates, new_opt = alg.local_optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             active = i < steps_eff
             carry = _tree_where(active, (new_params, new_opt), (params, opt_state))
             return carry, jnp.where(active, loss, 0.0)
 
+        init = (params0, opt_state0)
+        if varying_init:
+            # Replicated initial carry accumulating shard-local data inside
+            # shard_map must be typed device-varying over dp.
+            init = _to_varying(init, "dp")
         (params, _), losses = jax.lax.scan(
-            step,
-            _to_varying((global_params, opt_state), "dp"),
-            jnp.arange(cfg.max_local_steps),
+            step, init, jnp.arange(cfg.max_local_steps)
         )
-        delta = jax.tree.map(jnp.subtract, params, global_params)
-        # NaN for clients that ran zero steps: "no work performed" must not
-        # read as success downstream (finiteness is the success signal).
         mean_loss = jnp.where(
             steps_eff > 0,
             losses.sum() / jnp.maximum(steps_eff, 1).astype(jnp.float32),
             jnp.float32(jnp.nan),
         )
+        return params, mean_loss
+
+    def _local_train(self, global_params, x, y, num_samples, num_steps, uid,
+                     base_key, round_idx):
+        """One client's local training: masked lax.scan over SGD steps.
+
+        Per-client RNG stream: fold_in(fold_in(base_key, uid), round) — stable
+        under any resharding of clients to devices, which is what makes the
+        accuracy-parity claim reproducible (SURVEY.md section 7 hard parts).
+        """
+        alg = self.algorithm
+        key = jax.random.fold_in(jax.random.fold_in(base_key, uid), round_idx)
+        # The scan length is static; clamp so a larger requested step count is
+        # an explicit cap, and metrics divide by the steps actually run.
+        steps_eff = jnp.minimum(num_steps, self.config.max_local_steps)
+
+        def loss_fn(p, xb, yb):
+            logits = self.apply_fn(p, xb)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+            if alg.prox_mu:
+                loss = loss + 0.5 * alg.prox_mu * _tree_l2_sq(p, global_params)
+            return loss
+
+        params, mean_loss = self._masked_sgd(
+            global_params, alg.local_optimizer.init(global_params),
+            x, y, num_samples, steps_eff, key, loss_fn, varying_init=True,
+        )
+        delta = jax.tree.map(jnp.subtract, params, global_params)
         return delta, mean_loss
+
+    def _personal_train(self, vparams, global_params, x, y, num_samples,
+                        num_steps, uid, active, base_key, round_idx):
+        """One client's Ditto personal branch (Ditto: Li et al. 2021):
+        v_k <- v_k - eta * (grad F_k(v_k) + lambda * (v_k - w)).
+
+        Runs in the same compiled program as the global branch; ``active``
+        (participation) gates every update so churned-out clients keep their
+        personal params frozen. The minibatch RNG stream is salted away from
+        the global branch's so the two branches see decorrelated batches.
+        """
+        alg = self.algorithm
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(base_key, uid), round_idx), 0x0D1770
+        )
+        store_dt = jax.tree.leaves(vparams)[0].dtype
+        v0 = jax.tree.map(lambda v, p: v.astype(p.dtype), vparams, global_params)
+        steps_eff = jnp.where(
+            active, jnp.minimum(num_steps, self.config.max_local_steps), 0
+        )
+
+        def loss_fn(v, xb, yb):
+            logits = self.apply_fn(v, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+        def ditto_pull(grads, v):
+            return jax.tree.map(
+                lambda g, vv, ww: g + alg.ditto_lambda * (vv - ww),
+                grads, v, global_params,
+            )
+
+        # The carry derives from the sharded per-client params, so it is
+        # already device-varying — no pcast (varying_init=False).
+        v, mean_loss = self._masked_sgd(
+            v0, alg.local_optimizer.init(v0), x, y, num_samples, steps_eff,
+            key, loss_fn, grad_transform=ditto_pull,
+        )
+        return jax.tree.map(lambda t: t.astype(store_dt), v), mean_loss
 
     # ----------------------------------------------------------- round step
     # NOTE on the mp axis: model params are currently replicated, so mp > 1
@@ -201,9 +269,10 @@ class FedCore:
         cfg = self.config
         alg = self.algorithm
         mesh = plan.mesh
+        personalized = alg.personalized
 
         def shard_body(params, opt_state, round_idx, base_key,
-                       x, y, num_samples, num_steps, uid, weight):
+                       x, y, num_samples, num_steps, uid, weight, vparams):
             c_local = x.shape[0]
             if c_local % cfg.block_clients != 0:
                 raise ValueError(
@@ -217,19 +286,21 @@ class FedCore:
                 return a.reshape((nb, cfg.block_clients) + a.shape[1:])
 
             xs = (blocked(x), blocked(y), blocked(num_samples),
-                  blocked(num_steps), blocked(uid), blocked(weight))
+                  blocked(num_steps), blocked(uid), blocked(weight),
+                  jax.tree.map(blocked, vparams) if personalized else None)
 
             zero_delta = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params
             )
-            init = (zero_delta, jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+            init = (zero_delta, jnp.float32(0.0), jnp.float32(0.0),
+                    jnp.float32(0.0), jnp.float32(0.0))
             # The carry accumulates device-varying values (per-shard client
             # sums), so its initial value must be typed as varying over dp.
             init = _to_varying(init, "dp")
 
             def block_step(carry, inp):
-                sum_delta, sum_w, sum_loss, count = carry
-                bx, by, bns, bst, buid, bw = inp
+                sum_delta, sum_w, sum_loss, count, sum_ploss = carry
+                bx, by, bns, bst, buid, bw, bvp = inp
                 deltas, losses = jax.vmap(
                     self._local_train,
                     in_axes=(None, 0, 0, 0, 0, 0, None, None),
@@ -241,12 +312,29 @@ class FedCore:
                 sum_w = sum_w + bw.sum()
                 sum_loss = sum_loss + (bw * losses).sum()
                 count = count + (bw > 0).sum().astype(jnp.float32)
-                return (sum_delta, sum_w, sum_loss, count), losses
+                if personalized:
+                    new_vp, plosses = jax.vmap(
+                        self._personal_train,
+                        in_axes=(0, None, 0, 0, 0, 0, 0, 0, None, None),
+                    )(bvp, params, bx, by, bns, bst, buid, bw > 0,
+                      base_key, round_idx)
+                    sum_ploss = sum_ploss + jnp.where(
+                        bw > 0, bw * plosses, 0.0
+                    ).sum()
+                    ys = (losses, new_vp)
+                else:
+                    ys = (losses, None)
+                return (sum_delta, sum_w, sum_loss, count, sum_ploss), ys
 
-            (sum_delta, sum_w, sum_loss, count), block_losses = jax.lax.scan(
+            carry, (block_losses, new_vparams) = jax.lax.scan(
                 block_step, init, xs
             )
+            sum_delta, sum_w, sum_loss, count, sum_ploss = carry
             client_loss = block_losses.reshape((c_local,))
+            if personalized:
+                new_vparams = jax.tree.map(
+                    lambda a: a.reshape((c_local,) + a.shape[2:]), new_vparams
+                )
 
             # Cross-device FedAvg: the Pulsar gradient transport of the
             # reference becomes one psum over the dp axis of the ICI mesh.
@@ -254,6 +342,7 @@ class FedCore:
             sum_w = jax.lax.psum(sum_w, "dp")
             sum_loss = jax.lax.psum(sum_loss, "dp")
             count = jax.lax.psum(count, "dp")
+            sum_ploss = jax.lax.psum(sum_ploss, "dp")
 
             denom = jnp.maximum(sum_w, 1e-8)
             mean_delta = jax.tree.map(lambda s: s / denom, sum_delta)
@@ -271,38 +360,84 @@ class FedCore:
                 weight_sum=sum_w,
                 clients_trained=count,
                 client_loss=client_loss,
+                personal_loss=sum_ploss / denom,
             )
-            return new_params, new_opt_state, round_idx + 1, metrics
+            return new_params, new_opt_state, round_idx + 1, metrics, new_vparams
 
         rep = P()
         cl = P("dp")
         metrics_specs = RoundMetrics(
-            mean_loss=rep, weight_sum=rep, clients_trained=rep, client_loss=cl
-        )
-        shard_fn = jax.shard_map(
-            shard_body,
-            mesh=mesh,
-            in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl),
-            out_specs=(rep, rep, rep, metrics_specs),
+            mean_loss=rep, weight_sum=rep, clients_trained=rep, client_loss=cl,
+            personal_loss=rep,
         )
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def round_step(state: ServerState, x, y, num_samples, num_steps, uid, weight):
-            new_params, new_opt_state, new_round, metrics = shard_fn(
-                state.params, state.opt_state, state.round_idx, state.base_key,
-                x, y, num_samples, num_steps, uid, weight,
+        def make_shard_fn(vp_tree):
+            vp_spec = jax.tree.map(lambda _: cl, vp_tree)
+            return jax.shard_map(
+                shard_body,
+                mesh=mesh,
+                in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl, vp_spec),
+                out_specs=(rep, rep, rep, metrics_specs, vp_spec),
             )
-            return (
-                ServerState(
-                    params=new_params,
-                    opt_state=new_opt_state,
-                    round_idx=new_round,
-                    base_key=state.base_key,
-                ),
-                metrics,
-            )
+
+        if personalized:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def round_step(state: ServerState, personal: PersonalState,
+                           x, y, num_samples, num_steps, uid, weight):
+                new_params, new_opt_state, new_round, metrics, new_vp = (
+                    make_shard_fn(personal.params)(
+                        state.params, state.opt_state, state.round_idx,
+                        state.base_key, x, y, num_samples, num_steps, uid,
+                        weight, personal.params,
+                    )
+                )
+                return (
+                    ServerState(
+                        params=new_params,
+                        opt_state=new_opt_state,
+                        round_idx=new_round,
+                        base_key=state.base_key,
+                    ),
+                    metrics,
+                    PersonalState(params=new_vp),
+                )
+        else:
+            shard_fn = make_shard_fn(None)
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def round_step(state: ServerState, x, y, num_samples, num_steps, uid, weight):
+                new_params, new_opt_state, new_round, metrics, _ = shard_fn(
+                    state.params, state.opt_state, state.round_idx, state.base_key,
+                    x, y, num_samples, num_steps, uid, weight, None,
+                )
+                return (
+                    ServerState(
+                        params=new_params,
+                        opt_state=new_opt_state,
+                        round_idx=new_round,
+                        base_key=state.base_key,
+                    ),
+                    metrics,
+                )
 
         return round_step
+
+    def init_personal(self, state: ServerState, num_clients: int) -> PersonalState:
+        """Materialize Ditto personal params for ``num_clients`` (padded)
+        clients: every client starts at the current global model, stored
+        sharded over ``dp`` in ``config.personal_dtype``."""
+        dt = self.config.personal_dtype
+        sh = self.plan.client_sharding()
+
+        def tile(p):
+            target = p.astype(dt) if dt is not None else p
+            return jnp.broadcast_to(target[None], (num_clients,) + p.shape)
+
+        tiled = jax.jit(
+            lambda params: jax.tree.map(tile, params),
+            out_shardings=jax.tree.map(lambda _: sh, state.params),
+        )(state.params)
+        return PersonalState(params=tiled)
 
     def round_step(
         self,
@@ -310,18 +445,36 @@ class FedCore:
         ds: ClientDataset,
         participate: Optional[jax.Array] = None,
         num_steps: Optional[jax.Array] = None,
-    ) -> Tuple[ServerState, RoundMetrics]:
+        personal: Optional[PersonalState] = None,
+    ):
         """Advance one FL round over the (placed, padded) population.
 
         ``participate`` — optional [C] 0/1 mask from the deviceflow trace
         compiler; multiplies the base weights. ``num_steps`` — optional
         per-client local-step counts (hetero compute profiles); defaults to
-        ``max_local_steps`` everywhere.
+        ``max_local_steps`` everywhere. ``personal`` — Ditto per-client state
+        (required iff the algorithm is personalized); when given the return is
+        ``(state, metrics, personal)``.
         """
         weight = ds.weight if participate is None else ds.weight * participate
         if num_steps is None:
             num_steps = jnp.full((ds.num_clients,), self.config.max_local_steps, jnp.int32)
             num_steps = jax.device_put(num_steps, self.plan.client_sharding())
+        if self.algorithm.personalized:
+            if personal is None:
+                raise ValueError(
+                    f"algorithm {self.algorithm.name!r} is personalized; pass "
+                    f"personal=core.init_personal(state, ds.num_clients)"
+                )
+            return self._round_step(
+                state, personal, ds.x, ds.y, ds.num_samples, num_steps,
+                ds.client_uid, weight,
+            )
+        if personal is not None:
+            raise ValueError(
+                f"algorithm {self.algorithm.name!r} is not personalized but "
+                f"personal state was supplied"
+            )
         return self._round_step(
             state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid, weight
         )
@@ -336,6 +489,76 @@ class FedCore:
             return loss, acc
 
         return evaluate
+
+    def _build_evaluate_personal(self):
+        cl = P("dp")
+        rep = P()
+        block = self.config.block_clients
+
+        def shard_body(vparams, x, y, num_samples, weight):
+            # Block the client axis exactly like the train path so peak
+            # activation memory is bounded by block_clients * n_local, not
+            # clients_per_device * n_local.
+            c_local = x.shape[0]
+            nb = c_local // block
+
+            def blocked(a):
+                return a.reshape((nb, block) + a.shape[1:])
+
+            def one(v, xc, yc, ns):
+                logits = self.apply_fn(v, xc)
+                valid = (jnp.arange(xc.shape[0]) < ns)
+                losses = optax.softmax_cross_entropy_with_integer_labels(logits, yc)
+                correct = (logits.argmax(-1) == yc)
+                denom = jnp.maximum(ns, 1).astype(jnp.float32)
+                return (
+                    jnp.where(valid, losses, 0.0).sum() / denom,
+                    jnp.where(valid, correct, False).sum() / denom,
+                )
+
+            def block_step(carry, inp):
+                sum_loss, sum_acc, sum_w = carry
+                bvp, bx, by, bns, bw = inp
+                loss_c, acc_c = jax.vmap(one)(bvp, bx, by, bns)
+                return (
+                    sum_loss + (bw * loss_c).sum(),
+                    sum_acc + (bw * acc_c).sum(),
+                    sum_w + bw.sum(),
+                ), None
+
+            init = _to_varying(
+                (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0)), "dp"
+            )
+            xs = (jax.tree.map(blocked, vparams), blocked(x), blocked(y),
+                  blocked(num_samples), blocked(weight))
+            (sum_loss, sum_acc, sum_w), _ = jax.lax.scan(block_step, init, xs)
+            w_sum = jax.lax.psum(sum_w, "dp")
+            loss = jax.lax.psum(sum_loss, "dp") / jnp.maximum(w_sum, 1e-8)
+            acc = jax.lax.psum(sum_acc, "dp") / jnp.maximum(w_sum, 1e-8)
+            return loss, acc
+
+        def make(vp_tree):
+            vp_spec = jax.tree.map(lambda _: cl, vp_tree)
+            return jax.jit(
+                jax.shard_map(
+                    shard_body,
+                    mesh=self.plan.mesh,
+                    in_specs=(vp_spec, cl, cl, cl, cl),
+                    out_specs=(rep, rep),
+                )
+            )
+
+        return make
+
+    def evaluate_personal(self, personal: PersonalState, ds: ClientDataset) -> Tuple[float, float]:
+        """Ditto's metric of record: each client's personalized model scored
+        on its own local data (weight-averaged loss/accuracy)."""
+        if self._evaluate_personal is None:
+            self._evaluate_personal = self._build_evaluate_personal()(personal.params)
+        loss, acc = self._evaluate_personal(
+            personal.params, ds.x, ds.y, ds.num_samples, ds.weight
+        )
+        return float(loss), float(acc)
 
     def evaluate(self, params, x, y) -> Tuple[float, float]:
         """Centralized eval of the global model, batched on device."""

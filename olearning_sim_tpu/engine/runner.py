@@ -104,6 +104,8 @@ class SimulationRunner:
         self.stop_event = stop_event  # threading.Event; honored between rounds
         self.stopped = False
         self.states: Dict[str, Any] = {}
+        # Ditto per-client personal state per population (personalized algos).
+        self.personal_states: Dict[str, Any] = {}
         self.history: List[Dict[str, Any]] = []
 
         if not self.task_repo.has_task(task_id):
@@ -201,11 +203,22 @@ class SimulationRunner:
             trace.participate, self.core.plan.client_sharding()
         )
         state = self.states[p.name]
-        state, metrics = self.core.round_step(state, p.dataset, participate=participate)
+        if self.core.algorithm.personalized:
+            personal = self.personal_states.get(p.name)
+            if personal is None:
+                personal = self.core.init_personal(state, p.dataset.num_clients)
+            state, metrics, personal = self.core.round_step(
+                state, p.dataset, participate=participate, personal=personal
+            )
+            self.personal_states[p.name] = personal
+        else:
+            state, metrics = self.core.round_step(
+                state, p.dataset, participate=participate
+            )
         self.states[p.name] = state
         client_loss = np.asarray(jax.device_get(metrics.client_loss))
         ok = np.isfinite(client_loss)
-        return {
+        rec = {
             "mean_loss": float(metrics.mean_loss),
             "clients_trained": int(metrics.clients_trained),
             "released": trace.num_released,
@@ -213,13 +226,22 @@ class SimulationRunner:
             "sim_duration_s": trace.round_duration(),
             "ok_mask": ok,
         }
+        if self.core.algorithm.personalized:
+            rec["personal_loss"] = float(metrics.personal_loss)
+        return rec
 
     def _run_eval(self, p: DataPopulation) -> Dict[str, Any]:
-        if p.eval_data is None:
-            return {"eval_loss": None, "eval_acc": None}
-        x, y = p.eval_data
-        loss, acc = self.core.evaluate(self.states[p.name].params, x, y)
-        return {"eval_loss": loss, "eval_acc": acc}
+        rec: Dict[str, Any] = {"eval_loss": None, "eval_acc": None}
+        if p.eval_data is not None:
+            x, y = p.eval_data
+            loss, acc = self.core.evaluate(self.states[p.name].params, x, y)
+            rec.update(eval_loss=loss, eval_acc=acc)
+        personal = self.personal_states.get(p.name)
+        if personal is not None:
+            # Ditto metric of record: personalized models on own local data.
+            ploss, pacc = self.core.evaluate_personal(personal, p.dataset)
+            rec.update(personal_eval_loss=ploss, personal_eval_acc=pacc)
+        return rec
 
     # -------------------------------------------------------------------- run
     def run(self) -> List[Dict[str, Any]]:

@@ -134,14 +134,20 @@ def build_runner_from_taskconfig(
     # Token models (int input dtype) get the text population; everything else
     # the Gaussian-blob image/feature population.
     is_text = np.issubdtype(np.dtype(spec.input_dtype), np.integer)
-    vocab_size = int(
-        syn.get(
-            "vocab_size",
-            (model_cfg.get("overrides") or {}).get(
-                "vocab_size", spec.defaults.get("vocab_size", 30522)
-            ),
+    # The model's embedding table is the source of truth for vocab size; a
+    # mismatched data vocab would silently clamp out-of-range token gathers.
+    model_vocab = int(
+        (model_cfg.get("overrides") or {}).get(
+            "vocab_size", spec.defaults.get("vocab_size", 30522)
         )
     )
+    vocab_size = int(syn.get("vocab_size", model_vocab))
+    if is_text and vocab_size > model_vocab:
+        raise ValueError(
+            f"data.synthetic.vocab_size={vocab_size} exceeds the model's "
+            f"vocab_size={model_vocab}; token ids would fall outside the "
+            f"embedding table"
+        )
 
     populations = []
     for td in tc.target.targetData:
