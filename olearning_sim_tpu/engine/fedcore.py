@@ -235,7 +235,6 @@ class FedCore:
         key = jax.random.fold_in(
             jax.random.fold_in(jax.random.fold_in(base_key, uid), round_idx), 0x0D1770
         )
-        store_dt = jax.tree.leaves(vparams)[0].dtype
         v0 = jax.tree.map(lambda v, p: v.astype(p.dtype), vparams, global_params)
         steps_eff = jnp.where(
             active, jnp.minimum(num_steps, self.config.max_local_steps), 0
@@ -257,7 +256,7 @@ class FedCore:
             v0, alg.local_optimizer.init(v0), x, y, num_samples, steps_eff,
             key, loss_fn, grad_transform=ditto_pull,
         )
-        return jax.tree.map(lambda t: t.astype(store_dt), v), mean_loss
+        return jax.tree.map(lambda t, orig: t.astype(orig.dtype), v, vparams), mean_loss
 
     # ----------------------------------------------------------- round step
     # NOTE on the mp axis: model params are currently replicated, so mp > 1
@@ -500,12 +499,27 @@ class FedCore:
             # activation memory is bounded by block_clients * n_local, not
             # clients_per_device * n_local.
             c_local = x.shape[0]
+            if c_local % block != 0:
+                raise ValueError(
+                    f"clients per device ({c_local}) must be a multiple of "
+                    f"block_clients={block}; pad the dataset with "
+                    f"ClientDataset.pad_for(plan, block=config.block_clients)"
+                )
             nb = c_local // block
 
             def blocked(a):
                 return a.reshape((nb, block) + a.shape[1:])
 
             def one(v, xc, yc, ns):
+                # Metrics of record are precision-stable: eval always computes
+                # in f32 regardless of the personal_dtype storage knob (the
+                # train path casts to the global-param compute dtype the same
+                # way).
+                v = jax.tree.map(
+                    lambda t: t.astype(jnp.float32)
+                    if jnp.issubdtype(t.dtype, jnp.floating) else t,
+                    v,
+                )
                 logits = self.apply_fn(v, xc)
                 valid = (jnp.arange(xc.shape[0]) < ns)
                 losses = optax.softmax_cross_entropy_with_integer_labels(logits, yc)

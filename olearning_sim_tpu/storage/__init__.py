@@ -1,0 +1,39 @@
+"""Storage repos: file transfer backends + gradient-fragment consumption.
+
+Re-specifies the reference's ``ols_core/ofl_commons/infrastructure/`` package,
+whose base classes (``FileRepo``, ``FragmentRepo``/``Fragment``) are absent
+from the open-source snapshot (SURVEY.md section 2.6) — only the S3/MinIO
+concrete impls survive (``s3_file_repo.py:7-64``, ``minio_file_repo.py:22-65``).
+"""
+
+from olearning_sim_tpu.storage.file_repo import (
+    FileRepo,
+    FileTransferType,
+    HttpFileRepo,
+    LocalFileRepo,
+    MinioFileRepo,
+    S3FileRepo,
+    fetch_operator_code,
+    make_file_repo,
+)
+from olearning_sim_tpu.storage.fragment_repo import (
+    Fragment,
+    FragmentRepo,
+    JsonFragmentRepo,
+    QueueFragmentRepo,
+)
+
+__all__ = [
+    "FileRepo",
+    "FileTransferType",
+    "LocalFileRepo",
+    "HttpFileRepo",
+    "S3FileRepo",
+    "MinioFileRepo",
+    "make_file_repo",
+    "fetch_operator_code",
+    "Fragment",
+    "FragmentRepo",
+    "JsonFragmentRepo",
+    "QueueFragmentRepo",
+]

@@ -1,0 +1,276 @@
+"""File-transfer backends behind one interface.
+
+The reference moves datasets, models, and operator code through four transfer
+types (``FileTransferType`` enum, ``ols_core/proto/taskService.proto:131-136``:
+FILE/HTTP/S3/MINIO), with concrete repos at
+``ofl_commons/infrastructure/FileRepo/s3_file_repo.py:7-64`` (boto3) and
+``minio_file_repo.py:22-65`` (minio), a wget/urllib path for HTTP
+(``taskMgr/utils/utils_run_task.py:174-325``), and plain paths for FILE.
+The abstract base the reference imports (``file_repo.py``) was never released,
+so this module re-specifies it: upload / download / delete / list /
+download_payload (download-then-delete, the reference's payload semantics).
+
+S3 and MinIO impls import their SDKs lazily and raise a clear error when the
+SDK is not installed — single-host mode needs neither.
+"""
+
+from __future__ import annotations
+
+import abc
+import enum
+import os
+import shutil
+import urllib.request
+import zipfile
+from typing import List, Optional
+
+from olearning_sim_tpu.proto import taskservice_pb2 as _pb
+
+# Single source of truth is the wire enum (taskservice.proto FileTransferType:
+# FILE/HTTP/S3/MINIO); this IntEnum view adds Python enum ergonomics without
+# duplicating the values.
+FileTransferType = enum.IntEnum(
+    "FileTransferType", dict(_pb.FileTransferType.items())
+)
+
+
+class FileRepo(abc.ABC):
+    """Narrow file-store interface shared by all transfer backends."""
+
+    @abc.abstractmethod
+    def upload_file(self, local_path: str, remote_path: str) -> bool:
+        """Copy a local file into the store at ``remote_path``."""
+
+    @abc.abstractmethod
+    def download_file(self, remote_path: str, local_path: str) -> bool:
+        """Copy ``remote_path`` out of the store to a local file."""
+
+    @abc.abstractmethod
+    def delete_file(self, remote_path: str) -> bool:
+        """Remove ``remote_path`` from the store."""
+
+    @abc.abstractmethod
+    def list_files(self, prefix: str = "") -> List[str]:
+        """All stored paths starting with ``prefix``."""
+
+    def download_payload(self, remote_path: str, local_path: str) -> bool:
+        """Download then delete (reference ``s3_file_repo.py`` download_payload
+        semantics: payloads are consumed, not mirrored)."""
+        if not self.download_file(remote_path, local_path):
+            return False
+        return self.delete_file(remote_path)
+
+    def exists(self, remote_path: str) -> bool:
+        return remote_path in self.list_files(remote_path)
+
+
+class LocalFileRepo(FileRepo):
+    """FILE transfer type: a rooted directory tree.
+
+    Remote paths are interpreted relative to ``root``; absolute remote paths
+    are allowed and used as-is (the reference's FILE mode passes raw host
+    paths, ``utils_run_task.py:196-214``).
+    """
+
+    def __init__(self, root: str = "/"):
+        self.root = root
+
+    def _resolve(self, remote_path: str) -> str:
+        if os.path.isabs(remote_path):
+            return remote_path
+        return os.path.join(self.root, remote_path)
+
+    def upload_file(self, local_path: str, remote_path: str) -> bool:
+        try:
+            dest = self._resolve(remote_path)
+            os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+            shutil.copyfile(local_path, dest)
+            return True
+        except OSError:
+            return False
+
+    def exists(self, remote_path: str) -> bool:
+        # Direct stat — the base-class list_files() walk would scan the whole
+        # root tree (root may be "/") just to answer a membership question.
+        return os.path.isfile(self._resolve(remote_path))
+
+    def download_file(self, remote_path: str, local_path: str) -> bool:
+        try:
+            src = self._resolve(remote_path)
+            os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+            shutil.copyfile(src, local_path)
+            return True
+        except OSError:
+            return False
+
+    def delete_file(self, remote_path: str) -> bool:
+        try:
+            os.remove(self._resolve(remote_path))
+            return True
+        except OSError:
+            return False
+
+    def list_files(self, prefix: str = "") -> List[str]:
+        base = self._resolve(prefix)
+        found: List[str] = []
+        if os.path.isfile(base):
+            return [prefix]
+        search_root = base if os.path.isdir(base) else (os.path.dirname(base) or ".")
+        if not os.path.isdir(search_root):
+            return []
+        for dirpath, _dirs, files in os.walk(search_root):
+            for f in files:
+                full = os.path.join(dirpath, f)
+                rel = os.path.relpath(full, self.root) if not os.path.isabs(prefix) else full
+                if rel.startswith(prefix):
+                    found.append(rel)
+        return sorted(found)
+
+
+class HttpFileRepo(FileRepo):
+    """HTTP transfer type: download-only (the reference fetches HTTP data with
+    wget/urllib, ``utils_run_task.py:216-233``; it never uploads over HTTP)."""
+
+    def upload_file(self, local_path: str, remote_path: str) -> bool:
+        raise NotImplementedError("HTTP transfer is download-only")
+
+    def download_file(self, remote_path: str, local_path: str) -> bool:
+        try:
+            os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+            with urllib.request.urlopen(remote_path) as resp, open(local_path, "wb") as out:
+                shutil.copyfileobj(resp, out)
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def delete_file(self, remote_path: str) -> bool:
+        raise NotImplementedError("HTTP transfer is download-only")
+
+    def list_files(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError("HTTP transfer is download-only")
+
+
+class S3FileRepo(FileRepo):
+    """S3 transfer type (reference ``s3_file_repo.py:7-64``, boto3). The SDK is
+    imported lazily so single-host deployments need no boto3."""
+
+    def __init__(self, endpoint_url: str, access_key: str, secret_key: str, bucket: str):
+        try:
+            import boto3  # noqa: PLC0415
+        except ImportError as e:  # pragma: no cover - exercised only without boto3
+            raise RuntimeError("S3 transfer requires boto3 (not installed)") from e
+        self.bucket = bucket
+        self._client = boto3.client(
+            "s3",
+            endpoint_url=endpoint_url,
+            aws_access_key_id=access_key,
+            aws_secret_access_key=secret_key,
+        )
+
+    def upload_file(self, local_path: str, remote_path: str) -> bool:
+        try:
+            self._client.upload_file(local_path, self.bucket, remote_path)
+            return True
+        except Exception:
+            return False
+
+    def download_file(self, remote_path: str, local_path: str) -> bool:
+        try:
+            os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+            self._client.download_file(self.bucket, remote_path, local_path)
+            return True
+        except Exception:
+            return False
+
+    def delete_file(self, remote_path: str) -> bool:
+        try:
+            self._client.delete_object(Bucket=self.bucket, Key=remote_path)
+            return True
+        except Exception:
+            return False
+
+    def list_files(self, prefix: str = "") -> List[str]:
+        out: List[str] = []
+        paginator = self._client.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=self.bucket, Prefix=prefix):
+            out.extend(obj["Key"] for obj in page.get("Contents", []))
+        return out
+
+
+class MinioFileRepo(FileRepo):
+    """MINIO transfer type (reference ``minio_file_repo.py:22-65``)."""
+
+    def __init__(self, endpoint: str, access_key: str, secret_key: str, bucket: str,
+                 secure: bool = False):
+        try:
+            from minio import Minio  # noqa: PLC0415
+        except ImportError as e:  # pragma: no cover - exercised only without minio
+            raise RuntimeError("MinIO transfer requires the minio SDK (not installed)") from e
+        self.bucket = bucket
+        self._client = Minio(endpoint, access_key=access_key, secret_key=secret_key,
+                             secure=secure)
+
+    def upload_file(self, local_path: str, remote_path: str) -> bool:
+        try:
+            self._client.fput_object(self.bucket, remote_path, local_path)
+            return True
+        except Exception:
+            return False
+
+    def download_file(self, remote_path: str, local_path: str) -> bool:
+        try:
+            self._client.fget_object(self.bucket, remote_path, local_path)
+            return True
+        except Exception:
+            return False
+
+    def delete_file(self, remote_path: str) -> bool:
+        try:
+            self._client.remove_object(self.bucket, remote_path)
+            return True
+        except Exception:
+            return False
+
+    def list_files(self, prefix: str = "") -> List[str]:
+        try:
+            return [o.object_name
+                    for o in self._client.list_objects(self.bucket, prefix=prefix,
+                                                       recursive=True)]
+        except Exception:
+            return []
+
+
+def make_file_repo(transfer_type: FileTransferType, *, root: str = "/",
+                   endpoint: str = "", access_key: str = "", secret_key: str = "",
+                   bucket: str = "", secure: bool = False) -> FileRepo:
+    """Factory keyed by the proto transfer-type enum (the dispatch the
+    reference does ad hoc at every download site, ``utils_run_task.py:174-325``)."""
+    t = FileTransferType(transfer_type)
+    if t == FileTransferType.FILE:
+        return LocalFileRepo(root=root)
+    if t == FileTransferType.HTTP:
+        return HttpFileRepo()
+    if t == FileTransferType.S3:
+        return S3FileRepo(endpoint_url=endpoint, access_key=access_key,
+                          secret_key=secret_key, bucket=bucket)
+    return MinioFileRepo(endpoint=endpoint, access_key=access_key,
+                         secret_key=secret_key, bucket=bucket, secure=secure)
+
+
+def fetch_operator_code(repo: FileRepo, remote_path: str, dest_dir: str,
+                        unzip: Optional[bool] = None) -> str:
+    """Fetch user operator code (zip or single file) into ``dest_dir`` and
+    return the code directory — the reference's ``get_operator_code``
+    (``taskMgr/utils/utils_runner.py:684-782``) without the temp-dir juggling.
+    Zips are extracted; a plain file is copied as-is."""
+    os.makedirs(dest_dir, exist_ok=True)
+    name = os.path.basename(remote_path)
+    local = os.path.join(dest_dir, name)
+    if not repo.download_file(remote_path, local):
+        raise FileNotFoundError(f"operator code not found: {remote_path}")
+    is_zip = unzip if unzip is not None else name.endswith(".zip")
+    if is_zip:
+        with zipfile.ZipFile(local) as zf:
+            zf.extractall(dest_dir)
+        os.remove(local)
+    return dest_dir

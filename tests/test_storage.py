@@ -1,0 +1,125 @@
+"""Storage layer: FileRepo backends + FragmentRepo."""
+
+import os
+import zipfile
+
+import pytest
+
+from olearning_sim_tpu.storage import (
+    FileTransferType,
+    Fragment,
+    HttpFileRepo,
+    JsonFragmentRepo,
+    LocalFileRepo,
+    QueueFragmentRepo,
+    fetch_operator_code,
+    make_file_repo,
+)
+
+
+@pytest.fixture
+def repo(tmp_path):
+    return LocalFileRepo(root=str(tmp_path / "store"))
+
+
+def test_local_roundtrip(tmp_path, repo):
+    src = tmp_path / "a.txt"
+    src.write_text("hello")
+    assert repo.upload_file(str(src), "data/a.txt")
+    dest = tmp_path / "out" / "a.txt"
+    assert repo.download_file("data/a.txt", str(dest))
+    assert dest.read_text() == "hello"
+    assert repo.list_files("data/") == ["data/a.txt"]
+    assert repo.exists("data/a.txt")
+    assert repo.delete_file("data/a.txt")
+    assert not repo.exists("data/a.txt")
+
+
+def test_local_download_payload_consumes(tmp_path, repo):
+    src = tmp_path / "p.bin"
+    src.write_bytes(b"\x01\x02")
+    repo.upload_file(str(src), "inbox/p.bin")
+    out = tmp_path / "got.bin"
+    assert repo.download_payload("inbox/p.bin", str(out))
+    assert out.read_bytes() == b"\x01\x02"
+    assert repo.list_files("inbox/") == []
+
+
+def test_local_missing_file(tmp_path, repo):
+    assert not repo.download_file("nope.txt", str(tmp_path / "x"))
+    assert not repo.delete_file("nope.txt")
+
+
+def test_local_absolute_paths(tmp_path):
+    repo = LocalFileRepo()
+    src = tmp_path / "abs.txt"
+    src.write_text("abs")
+    dest = tmp_path / "copy.txt"
+    assert repo.download_file(str(src), str(dest))
+    assert dest.read_text() == "abs"
+
+
+def test_factory_dispatch(tmp_path):
+    assert isinstance(make_file_repo(FileTransferType.FILE, root=str(tmp_path)),
+                      LocalFileRepo)
+    assert isinstance(make_file_repo(FileTransferType.HTTP), HttpFileRepo)
+
+
+def test_http_is_download_only():
+    http = HttpFileRepo()
+    with pytest.raises(NotImplementedError):
+        http.upload_file("a", "b")
+    with pytest.raises(NotImplementedError):
+        http.delete_file("a")
+
+
+def test_fetch_operator_code_zip(tmp_path, repo):
+    code = tmp_path / "op" / "train.py"
+    code.parent.mkdir()
+    code.write_text("print('train')")
+    z = tmp_path / "op.zip"
+    with zipfile.ZipFile(z, "w") as zf:
+        zf.write(code, "train.py")
+    repo.upload_file(str(z), "ops/op.zip")
+    dest = str(tmp_path / "fetched")
+    fetch_operator_code(repo, "ops/op.zip", dest)
+    assert os.path.exists(os.path.join(dest, "train.py"))
+    assert not os.path.exists(os.path.join(dest, "op.zip"))
+
+
+def test_fetch_operator_code_plain_file(tmp_path, repo):
+    code = tmp_path / "entry.py"
+    code.write_text("pass")
+    repo.upload_file(str(code), "ops/entry.py")
+    dest = str(tmp_path / "fetched2")
+    fetch_operator_code(repo, "ops/entry.py", dest)
+    assert os.path.exists(os.path.join(dest, "entry.py"))
+
+
+def test_fetch_operator_code_missing(tmp_path, repo):
+    with pytest.raises(FileNotFoundError):
+        fetch_operator_code(repo, "ops/ghost.zip", str(tmp_path / "d"))
+
+
+def test_fragment_roundtrip():
+    frag = Fragment(task_id="t1", client_id="c7", round_idx=3,
+                    payload=[0.5, -1.0], metrics={"train_tp_fragment": 0.91})
+    again = Fragment.deserialize(frag.serialize())
+    assert again == frag
+
+
+def test_queue_fragment_repo_fifo_and_drain():
+    repo = QueueFragmentRepo()
+    for i in range(5):
+        repo.put_fragment(Fragment("t", f"c{i}", 0))
+    assert repo.get_fragment(timeout=0).client_id == "c0"
+    rest = repo.drain()
+    assert [f.client_id for f in rest] == ["c1", "c2", "c3", "c4"]
+    assert repo.get_fragment(timeout=0) is None
+
+
+def test_json_fragment_repo_parses_on_receipt():
+    repo = JsonFragmentRepo()
+    repo.put_serialized(Fragment("t", "c1", 2, metrics={"loss": 0.2}).serialize())
+    frag = repo.get_fragment(timeout=0)
+    assert frag.round_idx == 2 and frag.metrics["loss"] == pytest.approx(0.2)
