@@ -1,0 +1,25 @@
+"""Round-scoped checkpoint / resume (Orbax-backed).
+
+The reference has no in-platform trainer checkpointing; its round analogue is
+the ``{task_id}_{round}_result_model.mnn`` file the aggregator writes per
+round and round r>0 re-downloads (``taskMgr/utils/utils_run_task.py:327-397``),
+plus MySQL-backed control-plane recovery (SURVEY.md section 5). The rebuild
+makes checkpointing first-class: per-round Orbax snapshots of (global params,
+optimizer state, round index, RNG, per-client personal state) with
+restore-and-resume, and a model-update exporter reproducing the reference's
+round-file convention for external aggregator interop.
+"""
+
+from olearning_sim_tpu.checkpoint.checkpointer import (
+    ModelUpdateExporter,
+    RoundCheckpointer,
+    export_model_bytes,
+    import_model_bytes,
+)
+
+__all__ = [
+    "RoundCheckpointer",
+    "ModelUpdateExporter",
+    "export_model_bytes",
+    "import_model_bytes",
+]

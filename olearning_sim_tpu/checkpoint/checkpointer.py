@@ -1,0 +1,190 @@
+"""Orbax round checkpointer + model-update export.
+
+Checkpoint unit per round: ``{"states": {population: ServerState},
+"personal": {population: PersonalState}}`` as an Orbax pytree plus a JSON
+sidecar with the round index and runner history. Typed PRNG keys are stored
+as raw key data (Orbax serializes arrays, not key types) and re-wrapped on
+restore.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from olearning_sim_tpu.storage.file_repo import FileRepo
+
+
+def _is_key(x) -> bool:
+    return isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    )
+
+
+def _strip_keys(tree):
+    """Typed PRNG key leaves -> raw uint32 key data (checkpointable)."""
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_key(x) else x, tree
+    )
+
+
+def _rewrap_keys(tree, template):
+    """Invert :func:`_strip_keys` using the template's key leaves."""
+    return jax.tree.map(
+        lambda t, x: jax.random.wrap_key_data(x) if _is_key(t) else x,
+        template,
+        tree,
+    )
+
+
+class RoundCheckpointer:
+    """Save/restore the full simulation state per round.
+
+    ``save`` is cheap to call every round; ``max_to_keep`` bounds disk use.
+    ``restore`` needs the freshly-initialized state as a template (shapes,
+    dtypes, shardings) — the same pattern as model init before
+    ``flax.serialization.from_bytes``.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = directory
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    # -------------------------------------------------------------- save
+    def save(self, round_idx: int, states: Dict[str, Any],
+             personal: Dict[str, Any], history: List[Dict[str, Any]]) -> None:
+        payload = {
+            "states": _strip_keys(states),
+            "personal": _strip_keys(personal),
+        }
+        meta = {"round_idx": int(round_idx), "history": _jsonable(history)}
+        self._mgr.save(
+            round_idx,
+            args=ocp.args.Composite(
+                tree=ocp.args.StandardSave(payload),
+                meta=ocp.args.JsonSave(meta),
+            ),
+        )
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    # ----------------------------------------------------------- restore
+    def latest_round(self) -> Optional[int]:
+        step = self._mgr.latest_step()
+        return None if step is None else int(step)
+
+    def restore(
+        self,
+        template_states: Dict[str, Any],
+        template_personal: Dict[str, Any],
+    ) -> Optional[Tuple[int, Dict[str, Any], Dict[str, Any], List[Dict[str, Any]]]]:
+        """Returns (last_completed_round, states, personal, history), or None
+        when no checkpoint exists."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        abstract = {
+            "states": jax.tree.map(
+                ocp.utils.to_shape_dtype_struct, _strip_keys(template_states)
+            ),
+            "personal": jax.tree.map(
+                ocp.utils.to_shape_dtype_struct, _strip_keys(template_personal)
+            ),
+        }
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                tree=ocp.args.StandardRestore(abstract),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        tree, meta = restored["tree"], restored["meta"]
+        states = _rewrap_keys(tree["states"], template_states)
+        personal = _rewrap_keys(tree["personal"], template_personal)
+        return int(meta["round_idx"]), states, personal, list(meta["history"])
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def _jsonable(obj):
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.generic,)):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj).tolist()
+    return obj
+
+
+# ---------------------------------------------------------------- model files
+def export_model_bytes(params: Any) -> bytes:
+    """Serialize a param pytree (flax msgpack wire format)."""
+    from flax import serialization
+
+    return serialization.to_bytes(jax.device_get(params))
+
+
+def import_model_bytes(template: Any, data: bytes) -> Any:
+    from flax import serialization
+
+    return serialization.from_bytes(template, data)
+
+
+@dataclasses.dataclass
+class ModelUpdateExporter:
+    """Round-file convention for external-aggregator interop.
+
+    Reference model_update_style: round r>0 downloads
+    ``{task_id}_{current_round}_result_model.mnn`` written by the aggregator
+    (``utils_run_task.py:327-397``); here the platform itself writes/reads the
+    per-round global model through any :class:`FileRepo`.
+    """
+
+    repo: FileRepo
+    task_id: str
+    update_style: str = "{task_id}_{round}_result_model.msgpack"
+    scratch_dir: str = "/tmp"
+
+    def _name(self, round_idx: int) -> str:
+        return self.update_style.format(task_id=self.task_id, round=round_idx)
+
+    def export(self, round_idx: int, params: Any) -> str:
+        import os
+
+        name = self._name(round_idx)
+        os.makedirs(self.scratch_dir, exist_ok=True)
+        local = os.path.join(self.scratch_dir, name)
+        with open(local, "wb") as f:
+            f.write(export_model_bytes(params))
+        if not self.repo.upload_file(local, name):
+            raise IOError(f"model export failed: {name}")
+        os.remove(local)
+        return name
+
+    def load(self, round_idx: int, template: Any) -> Any:
+        import os
+
+        name = self._name(round_idx)
+        os.makedirs(self.scratch_dir, exist_ok=True)
+        local = os.path.join(self.scratch_dir, name)
+        if not self.repo.download_file(name, local):
+            raise FileNotFoundError(f"round model not found: {name}")
+        with open(local, "rb") as f:
+            data = f.read()
+        os.remove(local)
+        return import_model_bytes(template, data)

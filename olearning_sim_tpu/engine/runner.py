@@ -90,6 +90,8 @@ class SimulationRunner:
         trace_seed: int = 0,
         logger: Optional[Logger] = None,
         stop_event: Optional[threading.Event] = None,
+        checkpointer: Optional[Any] = None,
+        checkpoint_every: int = 1,
     ):
         self.task_id = task_id
         self.core = core
@@ -102,6 +104,8 @@ class SimulationRunner:
         self.trace_seed = trace_seed
         self.logger = logger if logger is not None else Logger()
         self.stop_event = stop_event  # threading.Event; honored between rounds
+        self.checkpointer = checkpointer  # RoundCheckpointer (optional)
+        self.checkpoint_every = max(1, int(checkpoint_every))
         self.stopped = False
         self.states: Dict[str, Any] = {}
         # Ditto per-client personal state per population (personalized algos).
@@ -243,6 +247,50 @@ class SimulationRunner:
             rec.update(personal_eval_loss=ploss, personal_eval_acc=pacc)
         return rec
 
+    # ------------------------------------------------------------- checkpoint
+    def _try_resume(self) -> int:
+        """Restore the latest round checkpoint if one exists; returns the
+        round index to resume from (0 when starting fresh)."""
+        if self.checkpointer is None:
+            return 0
+        template_personal = dict(self.personal_states)
+        if self.core.algorithm.personalized:
+            for p in self.populations:
+                if p.name not in template_personal:
+                    template_personal[p.name] = self.core.init_personal(
+                        self.states[p.name], p.dataset.num_clients
+                    )
+        restored = self.checkpointer.restore(self.states, template_personal)
+        if restored is None:
+            return 0
+        last_round, states, personal, history = restored
+        self.states = states
+        self.personal_states = personal
+        self.history = history
+        self.logger.info(
+            task_id=self.task_id, system_name="engine", module_name="runner",
+            message=f"resumed from checkpoint: round {last_round} complete",
+        )
+        return last_round + 1
+
+    def _checkpoint(self, round_idx: int) -> None:
+        if self.checkpointer is None:
+            return
+        if (round_idx + 1) % self.checkpoint_every and round_idx != self.rounds - 1:
+            return
+        # Materialize personal state for every population before saving so the
+        # checkpoint's tree structure is deterministic (matches the restore
+        # template even when no train operator has run yet).
+        if self.core.algorithm.personalized:
+            for p in self.populations:
+                if p.name not in self.personal_states:
+                    self.personal_states[p.name] = self.core.init_personal(
+                        self.states[p.name], p.dataset.num_clients
+                    )
+        self.checkpointer.save(
+            round_idx, self.states, self.personal_states, self.history
+        )
+
     # -------------------------------------------------------------------- run
     def run(self) -> List[Dict[str, Any]]:
         for p in self.populations:
@@ -250,8 +298,9 @@ class SimulationRunner:
                 self.states[p.name] = self.core.init_state(
                     jax.random.key(hash(self.task_id) & 0x7FFFFFFF)
                 )
+        start_round = self._try_resume()
 
-        for round_idx in range(self.rounds):
+        for round_idx in range(start_round, self.rounds):
             if self.stop_event is not None and self.stop_event.is_set():
                 # Cooperative stop between rounds (reference analogue:
                 # stopTask -> Ray job stop, ``task_manager.py:358-455``).
@@ -290,6 +339,7 @@ class SimulationRunner:
                 round_record[operator.name] = op_record
 
             self.history.append(round_record)
+            self._checkpoint(round_idx)
 
             if not self.operator_flow.stop():
                 if self.stop_event is not None and self.stop_event.is_set():
@@ -300,4 +350,8 @@ class SimulationRunner:
                 # Final round: the work is done; don't block on the barrier
                 # (reference ``run_task.py:319-322``).
                 break
+        if self.checkpointer is not None:
+            # Orbax saves are async; block until the last step is durably
+            # committed so a process exit right after run() can't lose it.
+            self.checkpointer.wait()
         return self.history

@@ -140,7 +140,13 @@ class HttpFileRepo(FileRepo):
             with urllib.request.urlopen(remote_path) as resp, open(local_path, "wb") as out:
                 shutil.copyfileobj(resp, out)
             return True
-        except (OSError, ValueError):
+        except Exception:
+            # http.client errors (IncompleteRead etc.) are not OSErrors; keep
+            # the bool contract and don't leave a truncated file behind.
+            try:
+                os.remove(local_path)
+            except OSError:
+                pass
             return False
 
     def delete_file(self, remote_path: str) -> bool:

@@ -1,0 +1,159 @@
+"""Round checkpoint / resume + model-update export."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.checkpoint import (
+    ModelUpdateExporter,
+    RoundCheckpointer,
+    export_model_bytes,
+    import_model_bytes,
+)
+from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine.algorithms import ditto
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.engine.runner import DataPopulation, OperatorSpec, SimulationRunner
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+from olearning_sim_tpu.storage import LocalFileRepo
+
+
+@pytest.fixture(scope="module")
+def plan():
+    return make_mesh_plan()
+
+
+@pytest.fixture(scope="module")
+def core(plan):
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    return build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (16,), "num_classes": 4},
+        input_shape=(12,),
+    )
+
+
+def _dataset(plan, n=16):
+    return make_synthetic_dataset(
+        seed=1, num_clients=n, n_local=4, input_shape=(12,), num_classes=4
+    ).pad_for(plan, 2).place(plan)
+
+
+def _population(plan, name="pop"):
+    ds = _dataset(plan)
+    return DataPopulation(
+        name=name, dataset=ds, device_classes=["hpc"],
+        class_of_client=np.zeros(ds.num_clients, int),
+        nums=[ds.num_real_clients], dynamic_nums=[0],
+    )
+
+
+def _runner(core, plan, tmp, task_id="ckpt-task", rounds=4, ckpt=None):
+    return SimulationRunner(
+        task_id=task_id,
+        core=core,
+        populations=[_population(plan)],
+        operators=[OperatorSpec(name="train", kind="train")],
+        rounds=rounds,
+        checkpointer=ckpt,
+    )
+
+
+def test_save_restore_roundtrip(core, plan, tmp_path):
+    ckpt = RoundCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+    runner = _runner(core, plan, tmp_path, ckpt=ckpt)
+    history = runner.run()
+    assert len(history) == 4
+    ckpt.wait()
+    assert ckpt.latest_round() == 3
+
+    # Fresh runner restores and has nothing left to do.
+    runner2 = _runner(core, plan, tmp_path, ckpt=ckpt)
+    history2 = runner2.run()
+    assert len(history2) == 4
+    assert history2[0]["train"]["pop"]["mean_loss"] == pytest.approx(
+        history[0]["train"]["pop"]["mean_loss"], rel=1e-5
+    )
+    # Restored params match the originals bitwise.
+    a = jax.tree.leaves(runner.states["pop"].params)
+    b = jax.tree.leaves(runner2.states["pop"].params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    ckpt.close()
+
+
+def test_resume_midway_matches_straight_run(core, plan, tmp_path):
+    # Straight 4-round run...
+    full = _runner(core, plan, tmp_path, task_id="t-straight")
+    h_full = full.run()
+    # ...vs 2 rounds, crash, resume to 4 (same task_id -> same init RNG).
+    ckpt = RoundCheckpointer(str(tmp_path / "ck2"))
+    first = _runner(core, plan, tmp_path, task_id="t-straight", rounds=2, ckpt=ckpt)
+    first.run()
+    ckpt.wait()
+    resumed = _runner(core, plan, tmp_path, task_id="t-straight", rounds=4, ckpt=ckpt)
+    h_res = resumed.run()
+    assert len(h_res) == 4
+    assert [r["round"] for r in h_res] == [0, 1, 2, 3]
+    assert h_res[-1]["train"]["pop"]["mean_loss"] == pytest.approx(
+        h_full[-1]["train"]["pop"]["mean_loss"], rel=1e-4
+    )
+    ckpt.close()
+
+
+def test_max_to_keep_bounds_disk(core, plan, tmp_path):
+    ckpt = RoundCheckpointer(str(tmp_path / "ck3"), max_to_keep=2)
+    runner = _runner(core, plan, tmp_path, ckpt=ckpt)
+    runner.run()
+    ckpt.wait()
+    steps = sorted(int(p.name) for p in (tmp_path / "ck3").iterdir() if p.name.isdigit())
+    assert len(steps) <= 2 and steps[-1] == 3
+    ckpt.close()
+
+
+def test_personalized_state_checkpointed(plan, tmp_path):
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "mlp2", ditto(0.1, lam=0.5), plan, cfg,
+        model_overrides={"hidden": (16,), "num_classes": 4},
+        input_shape=(12,),
+    )
+    ckpt = RoundCheckpointer(str(tmp_path / "ck4"))
+    runner = _runner(core, plan, tmp_path, task_id="t-ditto", rounds=2, ckpt=ckpt)
+    runner.run()
+    ckpt.wait()
+    runner2 = _runner(core, plan, tmp_path, task_id="t-ditto", rounds=2, ckpt=ckpt)
+    runner2.run()
+    a = jax.tree.leaves(runner.personal_states["pop"].params)
+    b = jax.tree.leaves(runner2.personal_states["pop"].params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    ckpt.close()
+
+
+def test_model_bytes_roundtrip(core):
+    state = core.init_state(jax.random.key(7))
+    data = export_model_bytes(state.params)
+    zeroed = jax.tree.map(jnp.zeros_like, state.params)
+    back = import_model_bytes(jax.device_get(zeroed), data)
+    for x, y in zip(jax.tree.leaves(back), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_model_update_exporter_round_files(core, tmp_path):
+    repo = LocalFileRepo(root=str(tmp_path / "store"))
+    exporter = ModelUpdateExporter(
+        repo, task_id="t9", scratch_dir=str(tmp_path / "scratch")
+    )
+    (tmp_path / "scratch").mkdir()
+    state = core.init_state(jax.random.key(3))
+    name = exporter.export(2, state.params)
+    assert name == "t9_2_result_model.msgpack"
+    assert repo.exists(name)
+    zeroed = jax.device_get(jax.tree.map(jnp.zeros_like, state.params))
+    loaded = exporter.load(2, zeroed)
+    for x, y in zip(jax.tree.leaves(loaded), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    with pytest.raises(FileNotFoundError):
+        exporter.load(5, zeroed)
