@@ -1,0 +1,31 @@
+"""Cluster substrate management: TPU slice CRUD + multi-host launch.
+
+The reference provisions elastic Ray-on-K8s clusters through a vendored
+KubeRay client (``ols_core/rayclusterMgr/kuberay_cluster_manager.py:59-225``:
+create/modify/delete/query RayCluster CRs; builder + utils). The TPU rebuild's
+cluster substrate is the accelerator fleet itself: :class:`ClusterManager`
+carves named logical *slices* out of the visible device topology and hands
+back device meshes, and :mod:`launcher` starts the multi-host (DCN) world via
+``jax.distributed`` — the analogue of the reference's KubeRay head/worker
+deployment recipes (``README.md:82-1180``).
+"""
+
+from olearning_sim_tpu.clustermgr.slice_manager import (
+    ClusterManager,
+    SliceSpec,
+    SliceStatus,
+)
+from olearning_sim_tpu.clustermgr.launcher import (
+    DistributedConfig,
+    MultiHostLauncher,
+    initialize_distributed,
+)
+
+__all__ = [
+    "ClusterManager",
+    "SliceSpec",
+    "SliceStatus",
+    "DistributedConfig",
+    "MultiHostLauncher",
+    "initialize_distributed",
+]

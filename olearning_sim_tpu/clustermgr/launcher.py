@@ -1,0 +1,155 @@
+"""Multi-host (DCN) world bring-up via ``jax.distributed``.
+
+The reference scales out by submitting Ray jobs to a KubeRay cluster
+(``taskMgr/task_runner.py:41-87``) and lets Ray place actors across hosts.
+The TPU rebuild's scale-out unit is a *process per host*, each driving its
+local devices, joined into one JAX world by ``jax.distributed.initialize`` —
+cross-host aggregation then rides the same compiled collectives as intra-slice
+(psum over ICI within a slice, DCN across slices; SURVEY.md section 2.5).
+
+Two pieces:
+
+- :func:`initialize_distributed` / :class:`DistributedConfig`: per-process
+  world join, configured explicitly or from standard environment variables.
+- :class:`MultiHostLauncher`: spawns N local worker processes (CPU backend)
+  running a user target function inside an initialized world — the test/dev
+  harness proving the DCN path without N real hosts, and the single-machine
+  analogue of the reference's job submission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    coordinator_address: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+
+    @staticmethod
+    def from_env() -> "DistributedConfig":
+        return DistributedConfig(
+            coordinator_address=os.environ.get("OLS_COORDINATOR_ADDRESS", ""),
+            num_processes=int(os.environ.get("OLS_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("OLS_PROCESS_ID", "0")),
+        )
+
+    def to_env(self) -> Dict[str, str]:
+        return {
+            "OLS_COORDINATOR_ADDRESS": self.coordinator_address,
+            "OLS_NUM_PROCESSES": str(self.num_processes),
+            "OLS_PROCESS_ID": str(self.process_id),
+        }
+
+
+def initialize_distributed(cfg: Optional[DistributedConfig] = None) -> DistributedConfig:
+    """Join the multi-process JAX world (no-op for a single process).
+
+    Call before any backend touch, mirroring ``jax.distributed`` requirements.
+    """
+    import jax
+
+    cfg = cfg if cfg is not None else DistributedConfig.from_env()
+    if cfg.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+    return cfg
+
+
+class MultiHostLauncher:
+    """Spawn an N-process world on this machine (one subprocess per "host").
+
+    Each worker runs ``python -m olearning_sim_tpu.clustermgr.worker`` with a
+    ``--target module:function`` import path; the worker joins the world, runs
+    the target, and exits 0 on success. Used by tests to validate multi-host
+    sharding/collectives on the CPU backend, and usable as a local launcher
+    for real multi-process runs.
+    """
+
+    def __init__(self, num_processes: int, coordinator_port: int = 29400,
+                 devices_per_process: int = 1, platform: str = "cpu"):
+        self.num_processes = int(num_processes)
+        self.coordinator_address = f"127.0.0.1:{coordinator_port}"
+        self.devices_per_process = int(devices_per_process)
+        self.platform = platform
+
+    def launch(self, target: str, args: Sequence[str] = (),
+               timeout: float = 300.0, extra_env: Optional[Dict[str, str]] = None,
+               ) -> List[subprocess.CompletedProcess]:
+        """Run ``target`` (``pkg.module:function``) in every process; returns
+        the completed processes (raises if any worker fails)."""
+        import threading
+
+        procs: List[subprocess.Popen] = []
+        outputs: List[List[str]] = []
+        readers: List[threading.Thread] = []
+        for pid in range(self.num_processes):
+            cfg = DistributedConfig(
+                coordinator_address=self.coordinator_address,
+                num_processes=self.num_processes,
+                process_id=pid,
+            )
+            env = dict(os.environ)
+            env.update(cfg.to_env())
+            env["OLS_PLATFORM"] = self.platform
+            if self.platform == "cpu" and self.devices_per_process > 1:
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={self.devices_per_process}"
+                ).strip()
+            if extra_env:
+                env.update(extra_env)
+            p = subprocess.Popen(
+                [sys.executable, "-m", "olearning_sim_tpu.clustermgr.worker",
+                 "--target", target, *args],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            procs.append(p)
+            # Drain every worker's pipe concurrently: a worker that logs more
+            # than the OS pipe buffer before a collective would otherwise
+            # block, deadlocking the whole world.
+            buf: List[str] = []
+            outputs.append(buf)
+            t = threading.Thread(
+                target=lambda f=p.stdout, b=buf: b.extend(f), daemon=True
+            )
+            t.start()
+            readers.append(t)
+
+        done: List[subprocess.CompletedProcess] = []
+        failures: List[str] = []
+        import time
+
+        deadline = time.monotonic() + timeout
+        for pid, p in enumerate(procs):
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                readers[pid].join(timeout=5)
+                failures.append(f"worker {pid} timed out\n{''.join(outputs[pid])}")
+                continue
+            readers[pid].join(timeout=5)
+            out = "".join(outputs[pid])
+            done.append(subprocess.CompletedProcess(p.args, p.returncode, out, ""))
+            if p.returncode != 0:
+                failures.append(f"worker {pid} exit {p.returncode}\n{out}")
+        if failures:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            raise RuntimeError("multi-host launch failed:\n" + "\n".join(failures))
+        return done

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from olearning_sim_tpu.parallel.mesh import MeshPlan, shard_clients
+from olearning_sim_tpu.parallel.mesh import MeshPlan, global_put, shard_clients
 
 
 @dataclasses.dataclass
@@ -93,7 +93,7 @@ class ClientDataset:
         sharded).
         """
         sh = plan.client_sharding()
-        put = lambda a: jax.device_put(np.asarray(a), sh)
+        put = lambda a: global_put(np.asarray(a), sh)
         return ClientDataset(
             x=put(self.x),
             y=put(self.y),

@@ -36,13 +36,14 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from olearning_sim_tpu.engine.algorithms import Algorithm
 from olearning_sim_tpu.engine.client_data import ClientDataset
-from olearning_sim_tpu.parallel.mesh import MeshPlan
+from olearning_sim_tpu.parallel.mesh import MeshPlan, global_put
 
 
 class ServerState(struct.PyTreeNode):
@@ -138,16 +139,20 @@ class FedCore:
 
     # ------------------------------------------------------------------ init
     def init_state(self, rng: jax.Array) -> ServerState:
-        pk, bk = jax.random.split(rng)
-        params = self.init_params_fn(pk)
-        opt_state = self.algorithm.server_optimizer.init(params)
-        state = ServerState(
-            params=params,
-            opt_state=opt_state,
-            round_idx=jnp.int32(0),
-            base_key=bk,
-        )
-        return jax.device_put(state, self.plan.replicated())
+        def make(rng):
+            pk, bk = jax.random.split(rng)
+            params = self.init_params_fn(pk)
+            opt_state = self.algorithm.server_optimizer.init(params)
+            return ServerState(
+                params=params,
+                opt_state=opt_state,
+                round_idx=jnp.int32(0),
+                base_key=bk,
+            )
+
+        # jit with out_shardings (not device_put) so replication also works on
+        # multi-host meshes, where the sharding spans non-addressable devices.
+        return jax.jit(make, out_shardings=self.plan.replicated())(rng)
 
     # ------------------------------------------------------- local training
     def _masked_sgd(self, params0, opt_state0, x, y, num_samples, steps_eff,
@@ -457,8 +462,10 @@ class FedCore:
         """
         weight = ds.weight if participate is None else ds.weight * participate
         if num_steps is None:
-            num_steps = jnp.full((ds.num_clients,), self.config.max_local_steps, jnp.int32)
-            num_steps = jax.device_put(num_steps, self.plan.client_sharding())
+            num_steps = global_put(
+                np.full((ds.num_clients,), self.config.max_local_steps, np.int32),
+                self.plan.client_sharding(),
+            )
         if self.algorithm.personalized:
             if personal is None:
                 raise ValueError(

@@ -36,6 +36,7 @@ from olearning_sim_tpu.deviceflow.service import DeviceFlowService
 from olearning_sim_tpu.deviceflow.trace_compiler import ClientTrace, compile_trace
 from olearning_sim_tpu.engine.client_data import ClientDataset
 from olearning_sim_tpu.engine.fedcore import FedCore
+from olearning_sim_tpu.parallel.mesh import global_put
 from olearning_sim_tpu.taskmgr.operator_flow import OperatorFlowController
 from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
 from olearning_sim_tpu.utils.logging import Logger
@@ -203,7 +204,7 @@ class SimulationRunner:
             operator=operator.name,
             seed=self.trace_seed,
         )
-        participate = jax.device_put(
+        participate = global_put(
             trace.participate, self.core.plan.client_sharding()
         )
         state = self.states[p.name]

@@ -82,6 +82,20 @@ def make_mesh_plan(
     return MeshPlan(mesh=Mesh(grid, ("dp", "mp")))
 
 
+def global_put(x, sharding: NamedSharding):
+    """Place a host array under ``sharding``, multi-controller-safe.
+
+    ``jax.device_put`` rejects shardings that span non-addressable devices
+    (multi-host meshes). There, every process holds the same full host array
+    (synthetic gen / file load is deterministic), so each contributes its
+    addressable shards via ``make_array_from_callback``.
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
 def pad_to_multiple(n: int, multiple: int) -> int:
     """Smallest m >= n with m % multiple == 0 (and m >= multiple)."""
     if multiple <= 0:
