@@ -24,6 +24,7 @@ Execution differences (the point of the rebuild):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import threading
@@ -93,6 +94,7 @@ class SimulationRunner:
         stop_event: Optional[threading.Event] = None,
         checkpointer: Optional[Any] = None,
         checkpoint_every: int = 1,
+        perf: Optional[Any] = None,
     ):
         self.task_id = task_id
         self.core = core
@@ -107,6 +109,7 @@ class SimulationRunner:
         self.stop_event = stop_event  # threading.Event; honored between rounds
         self.checkpointer = checkpointer  # RoundCheckpointer (optional)
         self.checkpoint_every = max(1, int(checkpoint_every))
+        self.perf = perf  # PerformanceManager (optional)
         self.stopped = False
         self.states: Dict[str, Any] = {}
         # Ditto per-client personal state per population (personalized algos).
@@ -318,23 +321,32 @@ class SimulationRunner:
                 routing_key = self._flow_start(operator, round_idx)
                 ok_by_population: Dict[str, np.ndarray] = {}
                 op_record: Dict[str, Any] = {}
-                for p in self.populations:
-                    if operator.kind == "train":
-                        r = self._run_train(p, round_idx, operator)
-                        ok_by_population[p.name] = r.pop("ok_mask")
-                    elif operator.kind == "eval":
-                        r = self._run_eval(p)
-                        ok_by_population[p.name] = np.ones(
-                            p.dataset.num_clients, bool
-                        )
-                    elif operator.kind == "custom":
-                        r = operator.custom_fn(self, round_idx, operator) or {}
-                        ok_by_population[p.name] = r.pop(
-                            "ok_mask", np.ones(p.dataset.num_clients, bool)
-                        )
-                    else:
-                        raise ValueError(f"unknown operator kind {operator.kind!r}")
-                    op_record[p.name] = r
+                # Only train operators advance clients: eval/custom must not
+                # inflate the device-rounds/sec metric of record.
+                nc = sum(p.dataset.num_real_clients for p in self.populations) \
+                    if operator.kind == "train" else 0
+                timer = self.perf.time_round(
+                    self.task_id, round_idx, operator.name, num_clients=nc,
+                    local_steps=self.core.config.max_local_steps,
+                ) if self.perf is not None else contextlib.nullcontext()
+                with timer:
+                    for p in self.populations:
+                        if operator.kind == "train":
+                            r = self._run_train(p, round_idx, operator)
+                            ok_by_population[p.name] = r.pop("ok_mask")
+                        elif operator.kind == "eval":
+                            r = self._run_eval(p)
+                            ok_by_population[p.name] = np.ones(
+                                p.dataset.num_clients, bool
+                            )
+                        elif operator.kind == "custom":
+                            r = operator.custom_fn(self, round_idx, operator) or {}
+                            ok_by_population[p.name] = r.pop(
+                                "ok_mask", np.ones(p.dataset.num_clients, bool)
+                            )
+                        else:
+                            raise ValueError(f"unknown operator kind {operator.kind!r}")
+                        op_record[p.name] = r
                 self._flow_complete(routing_key)
                 self._analyze_results(operator, round_idx, ok_by_population)
                 round_record[operator.name] = op_record

@@ -95,6 +95,8 @@ def build_runner_from_taskconfig(
     task_repo=None,
     deviceflow=None,
     stop_event: Optional["threading.Event"] = None,
+    perf=None,
+    checkpointer=None,
 ) -> SimulationRunner:
     """Build a ready-to-run SimulationRunner from a TaskConfig proto or the
     equivalent task JSON."""
@@ -244,4 +246,6 @@ def build_runner_from_taskconfig(
         deviceflow=deviceflow,
         operator_flow=flow,
         stop_event=stop_event,
+        perf=perf,
+        checkpointer=checkpointer,
     )

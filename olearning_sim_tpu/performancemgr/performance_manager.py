@@ -1,0 +1,169 @@
+"""Round-level performance accounting + profiler control.
+
+Metrics of record (BASELINE.md): FL rounds/sec, device-rounds/sec (clients
+advanced per wall-second), and per-client local-step latency. Timings are
+host wall-clock around the compiled round step (device work is synchronized
+by the runner's host transfer of the round loss, so the interval covers real
+execution, not async dispatch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from olearning_sim_tpu.utils.repo import MemoryTableRepo, TableRepo
+
+PERF_COLUMNS = ["task_id", "round_idx", "operator", "duration_s",
+                "num_clients", "local_steps", "extra"]
+
+
+@dataclasses.dataclass
+class RoundTiming:
+    task_id: str
+    round_idx: int
+    operator: str
+    duration_s: float
+    num_clients: int = 0
+    local_steps: int = 0
+    extra: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @property
+    def device_rounds_per_sec(self) -> float:
+        return self.num_clients / self.duration_s if self.duration_s > 0 else 0.0
+
+    @property
+    def per_client_step_latency_s(self) -> float:
+        """Amortized wall time per (client, local step) — the per-device-step
+        cost the reference models as alpha=3.5 s/device-round on CPU actors
+        (``utils_runner.py:941``)."""
+        steps = self.num_clients * max(self.local_steps, 1)
+        return self.duration_s / steps if steps else 0.0
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class PerformanceManager:
+    """Records timings, answers performance queries, controls the profiler."""
+
+    def __init__(self, repo: Optional[TableRepo] = None, keep_last: int = 4096):
+        # No repo by default: queries are answered from the bounded in-memory
+        # window. Pass a repo to persist every row for external analysis —
+        # retention is then the caller's policy (rows are append-only).
+        self.repo = repo
+        self.keep_last = keep_last
+        self._lock = threading.RLock()
+        self._timings: Dict[str, List[RoundTiming]] = {}
+        self._trace_dir: Optional[str] = None
+
+    # ------------------------------------------------------------- recording
+    def record_round(self, timing: RoundTiming) -> None:
+        with self._lock:
+            rows = self._timings.setdefault(timing.task_id, [])
+            rows.append(timing)
+            if len(rows) > self.keep_last:
+                del rows[: len(rows) - self.keep_last]
+            if self.repo is None:
+                return
+            self.repo.add_item({
+                "task_id": [timing.task_id],
+                "round_idx": [str(timing.round_idx)],
+                "operator": [timing.operator],
+                "duration_s": [repr(timing.duration_s)],
+                "num_clients": [str(timing.num_clients)],
+                "local_steps": [str(timing.local_steps)],
+                "extra": [json.dumps(timing.extra)],
+            })
+
+    class _Timer:
+        def __init__(self, mgr: "PerformanceManager", task_id: str,
+                     round_idx: int, operator: str, num_clients: int,
+                     local_steps: int):
+            self._mgr = mgr
+            self._args = (task_id, round_idx, operator, num_clients, local_steps)
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            if exc_type is None:
+                task_id, round_idx, operator, nc, ls = self._args
+                self._mgr.record_round(RoundTiming(
+                    task_id=task_id, round_idx=round_idx, operator=operator,
+                    duration_s=time.perf_counter() - self._t0,
+                    num_clients=nc, local_steps=ls,
+                ))
+            return False
+
+    def time_round(self, task_id: str, round_idx: int, operator: str,
+                   num_clients: int = 0, local_steps: int = 0) -> "_Timer":
+        """``with perf.time_round(...):`` around one operator execution."""
+        return PerformanceManager._Timer(
+            self, task_id, round_idx, operator, num_clients, local_steps
+        )
+
+    # --------------------------------------------------------------- queries
+    def get_performance(self, task_id: str) -> Dict[str, Any]:
+        """Summary for one task: throughput + latency distribution
+        (the ``PerformanceMgr.getPerformance`` answer)."""
+        with self._lock:
+            rows = list(self._timings.get(task_id, []))
+        if not rows:
+            return {"task_id": task_id, "rounds_recorded": 0}
+        durations = sorted(t.duration_s for t in rows)
+        total_time = sum(durations)
+        total_clients = sum(t.num_clients for t in rows)
+        distinct_rounds = len({t.round_idx for t in rows})
+        return {
+            "task_id": task_id,
+            "rounds_recorded": distinct_rounds,
+            "operator_executions": len(rows),
+            "total_time_s": total_time,
+            "rounds_per_sec": distinct_rounds / total_time if total_time else 0.0,
+            "device_rounds_per_sec": total_clients / total_time if total_time else 0.0,
+            "round_time_s": {
+                "mean": total_time / len(durations),
+                "p50": _percentile(durations, 0.50),
+                "p95": _percentile(durations, 0.95),
+                "max": durations[-1],
+            },
+            "per_client_step_latency_s": (
+                sum(t.per_client_step_latency_s for t in rows) / len(rows)
+            ),
+        }
+
+    def list_tasks(self) -> List[str]:
+        with self._lock:
+            return sorted(self._timings)
+
+    # -------------------------------------------------------------- profiler
+    def start_trace(self, logdir: str) -> bool:
+        """Begin a ``jax.profiler`` trace (XLA op-level timeline viewable in
+        TensorBoard/Perfetto). One trace at a time."""
+        import jax
+
+        with self._lock:
+            if self._trace_dir is not None:
+                return False
+            jax.profiler.start_trace(logdir)
+            self._trace_dir = logdir
+            return True
+
+    def stop_trace(self) -> Optional[str]:
+        import jax
+
+        with self._lock:
+            if self._trace_dir is None:
+                return None
+            jax.profiler.stop_trace()
+            out, self._trace_dir = self._trace_dir, None
+            return out

@@ -1,0 +1,27 @@
+"""gRPC surfaces for the non-TaskMgr control-plane services, plus the
+one-process session composer (reference ``simu_session.py:25-70``)."""
+
+from olearning_sim_tpu.services.grpc_services import (
+    DeviceFlowClient,
+    DeviceFlowServicer,
+    PerformanceMgrClient,
+    PerformanceMgrServicer,
+    PhoneManagerClient,
+    PhoneManagerServicer,
+    ResourceMgrClient,
+    ResourceMgrServicer,
+    SliceMgrClient,
+    SliceMgrServicer,
+    add_service_to_server,
+)
+from olearning_sim_tpu.services.session import SimulatorSession
+
+__all__ = [
+    "ResourceMgrServicer", "ResourceMgrClient",
+    "DeviceFlowServicer", "DeviceFlowClient",
+    "PhoneManagerServicer", "PhoneManagerClient",
+    "SliceMgrServicer", "SliceMgrClient",
+    "PerformanceMgrServicer", "PerformanceMgrClient",
+    "add_service_to_server",
+    "SimulatorSession",
+]

@@ -1,0 +1,137 @@
+"""SimulatorSession — one gRPC server hosting the selected control-plane
+services (reference ``ols_core/simu_session.py:25-70``: boots
+TaskMgr/ResourceMgr/RayClusterMgr/PerformanceMgr into one process by ``svc``
+selector; here DeviceFlow and PhoneManager join the same process too, since
+no external Pulsar/phone-farm processes are required in single-host mode).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Iterable, Optional, Tuple
+
+import grpc
+
+from olearning_sim_tpu.services.grpc_services import (
+    DeviceFlowServicer,
+    PerformanceMgrServicer,
+    PhoneManagerServicer,
+    ResourceMgrServicer,
+    SliceMgrServicer,
+    add_service_to_server,
+)
+
+ALL_SERVICES = ("taskmgr", "resourcemgr", "deviceflow", "phonemgr",
+                "slicemgr", "performancemgr")
+
+
+class SimulatorSession:
+    """Compose managers into one served process.
+
+    Any manager may be None (service omitted) — matching the reference's
+    ``svc`` list selector. Construction wires defaults so
+    ``SimulatorSession().start()`` gives a fully working single-host platform:
+    ResourceManager over the local device topology, DeviceFlowService,
+    PerformanceManager, ClusterManager, and a TaskManager wired to all of
+    them (plus an optional SimulatedPhoneFarm).
+    """
+
+    def __init__(
+        self,
+        services: Iterable[str] = ALL_SERVICES,
+        address: str = "127.0.0.1:0",
+        task_manager=None,
+        resource_manager=None,
+        deviceflow=None,
+        phone_farm=None,
+        cluster_manager=None,
+        performance_manager=None,
+        max_workers: int = 16,
+    ):
+        self.services = tuple(services)
+        self.address = address
+        self._server: Optional[grpc.Server] = None
+        self.port: Optional[int] = None
+
+        if "resourcemgr" in self.services and resource_manager is None:
+            from olearning_sim_tpu.resourcemgr.resource_manager import ResourceManager
+
+            phone_provider = (
+                phone_farm.get_device_available_resource
+                if phone_farm is not None else None
+            )
+            resource_manager = ResourceManager(phone_provider=phone_provider)
+        if "deviceflow" in self.services and deviceflow is None:
+            from olearning_sim_tpu.deviceflow.service import DeviceFlowService
+
+            deviceflow = DeviceFlowService()
+        if "slicemgr" in self.services and cluster_manager is None:
+            from olearning_sim_tpu.clustermgr import ClusterManager
+
+            cluster_manager = ClusterManager()
+        if "performancemgr" in self.services and performance_manager is None:
+            from olearning_sim_tpu.performancemgr import PerformanceManager
+
+            performance_manager = PerformanceManager()
+        if "taskmgr" in self.services and task_manager is None:
+            from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+
+            task_manager = TaskManager(
+                resource_manager=resource_manager,
+                deviceflow=deviceflow,
+                phone_client=phone_farm,
+                perf=performance_manager,
+            )
+
+        self.task_manager = task_manager
+        self.resource_manager = resource_manager
+        self.deviceflow = deviceflow
+        self.phone_farm = phone_farm
+        self.cluster_manager = cluster_manager
+        self.performance_manager = performance_manager
+        self._max_workers = max_workers
+
+    # ------------------------------------------------------------------ boot
+    def start(self) -> Tuple[grpc.Server, int]:
+        server = grpc.server(futures.ThreadPoolExecutor(self._max_workers))
+        if "taskmgr" in self.services and self.task_manager is not None:
+            from olearning_sim_tpu.taskmgr.grpc_service import (
+                TaskMgrServicer,
+                add_taskmgr_to_server,
+            )
+
+            add_taskmgr_to_server(TaskMgrServicer(self.task_manager), server)
+            self.task_manager.start()
+        if "resourcemgr" in self.services and self.resource_manager is not None:
+            add_service_to_server(ResourceMgrServicer(self.resource_manager), server)
+        if "deviceflow" in self.services and self.deviceflow is not None:
+            add_service_to_server(DeviceFlowServicer(self.deviceflow), server)
+            self.deviceflow.start()
+        if "phonemgr" in self.services and self.phone_farm is not None:
+            add_service_to_server(PhoneManagerServicer(self.phone_farm), server)
+        if "slicemgr" in self.services and self.cluster_manager is not None:
+            add_service_to_server(SliceMgrServicer(self.cluster_manager), server)
+        if "performancemgr" in self.services and self.performance_manager is not None:
+            add_service_to_server(
+                PerformanceMgrServicer(self.performance_manager), server
+            )
+        self.port = server.add_insecure_port(self.address)
+        server.start()
+        self._server = server
+        return server, self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace)
+            self._server = None
+        if self.task_manager is not None and hasattr(self.task_manager, "stop"):
+            self.task_manager.stop()
+        if self.deviceflow is not None and hasattr(self.deviceflow, "stop"):
+            self.deviceflow.stop()
+
+    def __enter__(self) -> "SimulatorSession":
+        self.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()

@@ -46,6 +46,16 @@ def _logical_nums(td) -> list:
     return list(td.totalSimulation.numTotalSimulation)
 
 
+def _device_nums(td) -> list:
+    """The device (phone) half's share: present only when the allocation
+    explicitly routes device-rounds to phones (reference
+    ``assemble_info_device_simulation``, ``utils_runner.py:563-628``)."""
+    alloc = list(td.allocation.allocationDeviceSimulation)
+    if alloc and any(a > 0 for a in alloc):
+        return alloc
+    return []
+
+
 def _total_simulation_entry(tc: pb.TaskConfig) -> Dict[str, Any]:
     """The persisted ``total_simulation`` blob consumed by the status
     calculus (reference ``task_manager.py:217-244``)."""
@@ -83,6 +93,7 @@ class TaskManager:
         interrupt_running_time: float = 172800.0,
         auto_create_rows: bool = True,
         cost_model=None,
+        perf=None,
         logger: Optional[Logger] = None,
     ):
         """``runner_factory(task_config, task_repo, deviceflow, stop_event)``
@@ -95,6 +106,7 @@ class TaskManager:
         self._runner_factory = runner_factory or self._default_runner_factory
         self._deviceflow = deviceflow
         self._phone_client = phone_client
+        self._perf = perf
         self._task_queue = TaskQueue()
         self._strategy = StrategyFactory.create_strategy(scheduler_strategy)
         self._schedule_interval = schedule_interval
@@ -153,7 +165,7 @@ class TaskManager:
 
         return build_runner_from_taskconfig(
             tc, task_repo=self._task_repo, deviceflow=self._deviceflow,
-            stop_event=stop_event,
+            stop_event=stop_event, perf=self._perf,
         )
 
     # ------------------------------------------------------------------ RPCs
@@ -204,6 +216,10 @@ class TaskManager:
             job_id = self._task_repo.get_item_value(task_id, "job_id")
             if job_id:
                 self._launcher.stop_job(job_id)
+                if self._phone_client is not None and \
+                        self._task_repo.get_item_value(task_id, "device_target"):
+                    # Reference stops the phone half too (task_manager.py:358-455).
+                    self._phone_client.stop_device(task_id)
                 self._task_repo.set_item_value(task_id, "task_status", TaskStatus.STOPPED.name)
                 return True
             if self._task_repo.has_task(task_id):
@@ -247,13 +263,63 @@ class TaskManager:
         except Exception:  # noqa: BLE001
             return False
 
+    def _submit_device_half(self, tc: pb.TaskConfig) -> bool:
+        """Launch the phone (device-simulation) sub-job when the allocation
+        routes device-rounds to phones (reference ``submit_phonejob``,
+        ``task_runner.py:89-114``). Returns False when the phone job could
+        not be launched (the caller fails the task)."""
+        if self._phone_client is None:
+            return True
+        task_id = tc.taskID.taskID
+        device_target = []
+        for td in tc.target.targetData:
+            nums = _device_nums(td)
+            if nums:
+                device_target.append({
+                    "name": td.dataName,
+                    "devices": list(td.totalSimulation.deviceTotalSimulation),
+                    "nums": nums,
+                })
+        if not device_target:
+            return True
+        ok = self._phone_client.submit_task(
+            task_id,
+            rounds=tc.operatorFlow.flowSetting.round,
+            operators=[op.name for op in tc.operatorFlow.operator],
+            data=device_target,
+        )
+        if not ok:
+            self.logger.error(task_id=task_id, system_name="TaskMgr",
+                              module_name="phone", message="phone job submit failed")
+            return False
+        self._task_repo.set_item_value(
+            task_id, "device_target", json.dumps({"device_target": [
+                {"name": d["name"],
+                 "simulation_target": {"devices": d["devices"], "nums": d["nums"]}}
+                for d in device_target
+            ]})
+        )
+        return True
+
     # --------------------------------------------------------- status fusion
     def _get_device_result(self, task_id: str) -> Dict[str, Any]:
         """Phone-side progress via the PhoneMgr client; absent in standalone
-        mode (reference ``task_manager.py:538-576``)."""
+        mode. Persists the device half so the status calculus reads both
+        halves from the repo (reference ``task_manager.py:538-576``)."""
         if self._phone_client is None:
             return {"is_finished": True, "device_result": []}
-        return self._phone_client.get_device_task_status(task_id)
+        if not self._task_repo.get_item_value(task_id, "device_target"):
+            # No device sub-job was launched for this task.
+            return {"is_finished": True, "device_result": []}
+        result = self._phone_client.get_device_task_status(task_id)
+        repo = self._task_repo
+        repo.set_item_value(task_id, "device_round", result.get("round", 0))
+        repo.set_item_value(task_id, "device_operator", result.get("operator", ""))
+        repo.set_item_value(
+            task_id, "device_result",
+            json.dumps({"device_result": result.get("device_result", [])}),
+        )
+        return result
 
     def _half_state(self, task_id: str, prefix: str) -> SimHalfState:
         target_blob = self._task_repo.get_item_value(task_id, f"{prefix}_target")
@@ -353,6 +419,18 @@ class TaskManager:
             ):
                 repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
                 return
+            # Freeze the phone share too (reference 2-phase freeze,
+            # task_scheduler.py:71-174) so concurrent hybrid tasks cannot
+            # oversubscribe the farm behind the scheduler's back.
+            for user_id, phones in result.task_request.get(
+                "device_simulation", {}
+            ).items():
+                if phones and not self._resource_manager.request_phone_resource(
+                    task_id, user_id, phones
+                ):
+                    self._resource_manager.release_resource(task_id)
+                    repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
+                    return
         if self._deviceflow is not None:
             uses_flow = any(
                 op.operationBehaviorController.useController
@@ -361,6 +439,13 @@ class TaskManager:
             if uses_flow:
                 # Reference DeviceflowResgister (utils_runner.py:630-671).
                 self._deviceflow.register_task(task_id, ["logical_simulation"])
+        if not self._submit_device_half(tc):
+            # A task whose device share cannot run must not report success
+            # with device-rounds silently dropped.
+            if self._resource_manager is not None:
+                self._resource_manager.release_resource(task_id)
+            repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
+            return
         try:
             job_id = self._launcher.submit(
                 lambda stop_event: self._runner_factory(tc, stop_event),
@@ -369,6 +454,11 @@ class TaskManager:
         except Exception as e:  # noqa: BLE001
             self.logger.error(task_id=task_id, system_name="TaskMgr",
                               module_name="submit", message=f"launch failed: {e}")
+            if self._phone_client is not None and \
+                    repo.get_item_value(task_id, "device_target"):
+                # The phone half launched before the engine failed; stop it so
+                # it doesn't run (and hold farm state) for a dead task.
+                self._phone_client.stop_device(task_id)
             if self._resource_manager is not None:
                 self._resource_manager.release_resource(task_id)
             repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)

@@ -1,0 +1,98 @@
+"""Performance manager: timings, summaries, runner integration, tracing."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.engine.runner import DataPopulation, OperatorSpec, SimulationRunner
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+from olearning_sim_tpu.performancemgr import PerformanceManager, RoundTiming
+from olearning_sim_tpu.utils.repo import MemoryTableRepo
+from olearning_sim_tpu.performancemgr.performance_manager import PERF_COLUMNS
+
+
+def test_round_timing_derived_metrics():
+    t = RoundTiming(task_id="t", round_idx=0, operator="train",
+                    duration_s=2.0, num_clients=100, local_steps=5)
+    assert t.device_rounds_per_sec == pytest.approx(50.0)
+    assert t.per_client_step_latency_s == pytest.approx(2.0 / 500)
+
+
+def test_record_and_summarize():
+    perf = PerformanceManager()
+    for r in range(10):
+        perf.record_round(RoundTiming("t1", r, "train", 0.1 + 0.01 * r,
+                                      num_clients=64, local_steps=2))
+    s = perf.get_performance("t1")
+    assert s["rounds_recorded"] == 10
+    assert s["operator_executions"] == 10
+    assert s["rounds_per_sec"] == pytest.approx(10 / s["total_time_s"])
+    assert s["round_time_s"]["p50"] >= s["round_time_s"]["mean"] * 0.5
+    assert s["round_time_s"]["max"] == pytest.approx(0.19)
+    assert perf.list_tasks() == ["t1"]
+    assert perf.get_performance("missing")["rounds_recorded"] == 0
+
+
+def test_timer_context():
+    perf = PerformanceManager()
+    with perf.time_round("t2", 0, "train", num_clients=8, local_steps=1):
+        time.sleep(0.01)
+    s = perf.get_performance("t2")
+    assert s["operator_executions"] == 1
+    assert s["total_time_s"] >= 0.01
+
+
+def test_rows_persisted():
+    repo = MemoryTableRepo(PERF_COLUMNS)
+    perf = PerformanceManager(repo=repo)
+    perf.record_round(RoundTiming("t3", 1, "train", 0.5, num_clients=4))
+    rows = repo.query_all()
+    assert len(rows) == 1 and rows[0]["task_id"] == "t3"
+
+
+def test_runner_records_perf():
+    plan = make_mesh_plan()
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (16,), "num_classes": 4},
+        input_shape=(12,),
+    )
+    ds = make_synthetic_dataset(
+        seed=1, num_clients=16, n_local=4, input_shape=(12,), num_classes=4
+    ).pad_for(plan, 2).place(plan)
+    perf = PerformanceManager()
+    runner = SimulationRunner(
+        task_id="perf-task", core=core,
+        populations=[DataPopulation(
+            name="pop", dataset=ds, device_classes=["hpc"],
+            class_of_client=np.zeros(ds.num_clients, int),
+            nums=[16], dynamic_nums=[0],
+        )],
+        operators=[OperatorSpec(name="train", kind="train")],
+        rounds=3, perf=perf,
+    )
+    runner.run()
+    s = perf.get_performance("perf-task")
+    assert s["rounds_recorded"] == 3
+    assert s["device_rounds_per_sec"] > 0
+
+
+def test_profiler_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    perf = PerformanceManager()
+    logdir = str(tmp_path / "trace")
+    assert perf.start_trace(logdir)
+    assert not perf.start_trace(logdir)  # one at a time
+    jnp.square(jnp.arange(8.0)).block_until_ready()
+    assert perf.stop_trace() == logdir
+    assert perf.stop_trace() is None
+    # Trace artifacts were written.
+    found = [f for _, _, fs in os.walk(logdir) for f in fs]
+    assert found, "no trace files written"

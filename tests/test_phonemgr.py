@@ -1,0 +1,140 @@
+"""Simulated phone farm + hybrid (logical+device) task end-to-end."""
+
+import json
+import time
+
+import pytest
+
+from olearning_sim_tpu.phonemgr import PhoneCostModel, SimulatedPhoneFarm
+from olearning_sim_tpu.taskmgr.status import TaskStatus
+
+
+@pytest.fixture
+def farm():
+    # speedup=1000: startup (8.808s) passes in ~9ms, each round in ~0.14ms.
+    return SimulatedPhoneFarm(
+        inventory={"user1": {"High": 10, "Low": 20}},
+        speedup=1000.0,
+    )
+
+
+def test_resource_freeze_release(farm):
+    avail = farm.get_device_available_resource()
+    assert avail["user1"] == {"High": 10, "Low": 20}
+    assert farm.request_device_resource("t1", "user1", {"High": 4})
+    assert farm.get_device_available_resource()["user1"]["High"] == 6
+    # over-request rejected
+    assert not farm.request_device_resource("t2", "user1", {"High": 7})
+    assert farm.release_device_resource("t1")
+    assert farm.get_device_available_resource()["user1"]["High"] == 10
+
+
+def test_job_progression_with_cost_model(farm):
+    data = [{"name": "d0", "devices": ["High", "Low"], "nums": [3, 5]}]
+    assert farm.submit_task("t1", rounds=5, operators=["train"], data=data)
+    assert not farm.submit_task("t1", rounds=5, operators=["train"], data=data)
+
+    # Immediately after submit: still inside the startup window.
+    st = farm.get_device_task_status("t1")
+    assert st["round"] == 0 and not st["is_finished"]
+
+    # Wait past startup + all rounds (simulated: 8.808 + 5*0.14 ~ 9.5s -> ~10ms).
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        st = farm.get_device_task_status("t1")
+        if st["is_finished"]:
+            break
+        time.sleep(0.005)
+    assert st["is_finished"] and st["round"] == 5
+    assert st["max_round"] == 5 and st["operator"] == "train"
+    tgt = st["device_result"][0]["simulation_target"]
+    assert tgt["devices"] == ["High", "Low"]
+    assert tgt["success_num"] == [3, 5]
+    assert tgt["failed_num"] == [0, 0]
+
+
+def test_stop_freezes_progress(farm):
+    farm.submit_task("t1", rounds=1000, operators=["train"],
+                     data=[{"name": "d0", "devices": ["High"], "nums": [2]}])
+    time.sleep(0.02)  # past startup, partway through rounds
+    assert farm.stop_device("t1")
+    r1 = farm.get_device_task_status("t1")["round"]
+    time.sleep(0.02)
+    r2 = farm.get_device_task_status("t1")["round"]
+    assert r2 == r1  # no progress after stop
+    assert farm.get_device_task_status("t1")["is_finished"]
+    assert not farm.stop_device("ghost")
+
+
+def test_failure_injection_deterministic():
+    farm = SimulatedPhoneFarm(
+        inventory={"u": {"High": 100}}, speedup=10000.0,
+        failure_rate=0.3, seed=7,
+    )
+    farm.submit_task("t", rounds=2, operators=["train"],
+                     data=[{"name": "d", "devices": ["High"], "nums": [100]}])
+    deadline = time.time() + 5
+    while not farm.get_device_task_status("t")["is_finished"]:
+        assert time.time() < deadline
+        time.sleep(0.002)
+    st = farm.get_device_task_status("t")
+    tgt = st["device_result"][0]["simulation_target"]
+    assert tgt["success_num"][0] + tgt["failed_num"][0] == 100
+    assert 0 < tgt["failed_num"][0] < 100
+    # Deterministic on re-query.
+    assert farm.get_device_task_status("t") == st
+
+
+def test_unknown_task_status(farm):
+    st = farm.get_device_task_status("nope")
+    assert not st["is_finished"] and st["device_result"] == []
+
+
+def test_hybrid_task_end_to_end():
+    """Task with explicit logical+device allocation: the logical half runs the
+    engine, the device half runs on the simulated farm, and status fusion
+    reaches SUCCEEDED only when both halves complete."""
+    from tests.test_taskmgr import make_task_json, wait_for  # shared fixtures
+    from olearning_sim_tpu.resourcemgr.resource_manager import (
+        ResourceManager, TpuTopology,
+    )
+    from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+    from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+
+    farm = SimulatedPhoneFarm(
+        inventory={"user1": {"high": 50}}, speedup=1000.0
+    )
+    topo = TpuTopology(num_chips=1, num_cores=8, platform="cpu",
+                       device_kinds=["cpu"], cpu=8.0, mem=8.0)
+    rm = ResourceManager(topology=topo,
+                         phone_provider=farm.get_device_available_resource)
+    mgr = TaskManager(resource_manager=rm, phone_client=farm,
+                      schedule_interval=0.05, release_interval=0.05,
+                      interrupt_interval=3600)
+    mgr.start()
+    try:
+        tj = make_task_json("hybrid_task", num_clients=16)
+        td = tj["target"]["data"][0]
+        # 16 device-rounds for the one class: 12 logical + 4 on phones.
+        td["allocation"] = {
+            "optimization": False,
+            "logical_simulation": [12],
+            "device_simulation": [4],
+            "running_response": {"devices": [], "nums": []},
+        }
+        tj["device_simulation"] = {
+            "resource_request": [{"name": "data_0", "devices": ["high"],
+                                  "num_request": [4]}]
+        }
+        tc = json2taskconfig(json.dumps(tj))
+        assert mgr.submit_task(tc)
+        assert wait_for(
+            lambda: mgr.get_task_status("hybrid_task") == TaskStatus.SUCCEEDED,
+            timeout=120,
+        ), f"status={mgr.get_task_status('hybrid_task')}"
+        # Device half was persisted for the status calculus.
+        blob = mgr._task_repo.get_item_value("hybrid_task", "device_result")
+        result = json.loads(blob)["device_result"]
+        assert result[0]["simulation_target"]["success_num"] == [4]
+    finally:
+        mgr.stop()

@@ -1,0 +1,141 @@
+"""SimulatorSession: one process, one gRPC server, all services."""
+
+import json
+
+import grpc
+import pytest
+
+from olearning_sim_tpu.phonemgr import SimulatedPhoneFarm
+from olearning_sim_tpu.resourcemgr.resource_manager import ResourceManager, TpuTopology
+from olearning_sim_tpu.services import (
+    DeviceFlowClient,
+    PerformanceMgrClient,
+    PhoneManagerClient,
+    ResourceMgrClient,
+    SimulatorSession,
+    SliceMgrClient,
+)
+from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+from olearning_sim_tpu.taskmgr.grpc_service import TaskMgrClient
+from olearning_sim_tpu.taskmgr.status import TaskStatus
+from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+
+from tests.test_taskmgr import make_task_json, wait_for
+
+
+@pytest.fixture
+def session():
+    farm = SimulatedPhoneFarm(inventory={"user1": {"high": 20}}, speedup=1000.0)
+    topo = TpuTopology(num_chips=1, num_cores=8, platform="cpu",
+                       device_kinds=["cpu"], cpu=8.0, mem=8.0)
+    rm = ResourceManager(topology=topo,
+                         phone_provider=farm.get_device_available_resource)
+    from olearning_sim_tpu.performancemgr import PerformanceManager
+
+    perf = PerformanceManager()
+    mgr = TaskManager(resource_manager=rm, phone_client=farm, perf=perf,
+                      schedule_interval=0.05, release_interval=0.05,
+                      interrupt_interval=3600)
+    sess = SimulatorSession(resource_manager=rm, task_manager=mgr,
+                            phone_farm=farm, performance_manager=perf)
+    sess.start()
+    yield sess
+    sess.stop()
+
+
+@pytest.fixture
+def channel(session):
+    with grpc.insecure_channel(f"127.0.0.1:{session.port}") as ch:
+        yield ch
+
+
+def test_all_services_respond(session, channel):
+    # ResourceMgr
+    res = ResourceMgrClient(channel).get_resource()
+    assert res["logical_simulation"]["cpu"] == 8.0
+    assert res["device_simulation"]["user1"]["high"] == 20
+
+    # SliceMgr
+    slices = SliceMgrClient(channel)
+    ok, _ = slices.create_slice("s1", 4, user_id="user1")
+    assert ok
+    q = slices.query_slice("s1")
+    assert q["num_devices"] == 4
+    ok, msg = slices.create_slice("s1", 2)
+    assert not ok and "exists" in msg
+    assert slices.delete_slice("s1")
+    assert slices.query_slice("s1") is None
+
+    # PhoneManager
+    phones = PhoneManagerClient(channel)
+    assert phones.get_device_available_resource() == {"user1": {"high": 20}}
+    assert phones.submit_task("pt", rounds=1, operators=["train"],
+                              data=[{"name": "d", "devices": ["high"],
+                                     "nums": [2]}])
+    st = wait_and_get(phones, "pt")
+    assert st["is_finished"] and st["round"] == 1
+
+    # DeviceFlow
+    flow = DeviceFlowClient(channel)
+    assert flow.register_task("ft", ["logical_simulation"])
+    strategy = json.dumps(
+        {"real_time_dispatch": {"use_strategy": True, "dispatch_batch_sizes": [5]}}
+    )
+    ok, _ = flow.notify_start("ft", "ft_train_0", "logical_simulation", strategy)
+    assert ok
+    ok, _ = flow.notify_complete("ft", "ft_train_0", "logical_simulation")
+    assert ok
+    assert wait_for(lambda: flow.check_dispatch_finished("ft"), timeout=30)
+    assert flow.unregister_task("ft")
+    assert flow.get_outbound_endpoint()["kind"] == "queue"
+
+    # PerformanceMgr
+    perf = PerformanceMgrClient(channel)
+    assert perf.get_performance("none")["rounds_recorded"] == 0
+
+
+def wait_and_get(phones, task_id, timeout=10):
+    import time
+
+    deadline = time.time() + timeout
+    st = phones.get_device_task_status(task_id)
+    while time.time() < deadline and not st["is_finished"]:
+        time.sleep(0.01)
+        st = phones.get_device_task_status(task_id)
+    return st
+
+
+def test_task_through_session(session, channel):
+    """Full platform path over the wire: submit -> scheduled -> engine ->
+    SUCCEEDED, with perf recorded."""
+    tasks = TaskMgrClient(channel)
+    tc = json2taskconfig(json.dumps(make_task_json("sess_task")))
+    assert tasks.submitTask(tc).is_success
+    assert wait_for(
+        lambda: tasks.getTaskStatus("sess_task").taskStatus
+        == int(TaskStatus.SUCCEEDED),
+        timeout=120,
+    ), f"status={tasks.getTaskStatus('sess_task').taskStatus}"
+
+    perf = PerformanceMgrClient(channel)
+    report = perf.get_performance("sess_task")
+    assert report["rounds_recorded"] >= 1
+    assert report["device_rounds_per_sec"] > 0
+
+
+def test_default_session_composition():
+    """SimulatorSession() with no args builds a working default stack."""
+    sess = SimulatorSession()
+    server, port = sess.start()
+    try:
+        assert port > 0
+        assert sess.task_manager is not None
+        assert sess.resource_manager is not None
+        assert sess.deviceflow is not None
+        assert sess.performance_manager is not None
+        assert sess.cluster_manager is not None
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            res = ResourceMgrClient(ch).get_resource()
+            assert res["logical_simulation"]["cpu"] > 0
+    finally:
+        sess.stop()
