@@ -50,9 +50,11 @@ class OperatorSpec:
 
     - ``train``: one FedCore round step;
     - ``eval``: centralized evaluation of the global model;
-    - ``custom``: host callback ``fn(runner, round_idx, operator) -> dict`` —
-      the escape hatch for arbitrary user operator code (reference operator
-      zips, ``base_operator.py``).
+    - ``custom``: host callback ``fn(runner, round_idx, operator,
+      population) -> dict`` — the escape hatch for arbitrary user operator
+      code (reference operator zips, ``base_operator.py``). Called once per
+      population; a returned ``ok_mask`` feeds per-class success accounting.
+      Callbacks that only take (runner, round_idx, operator) still work.
     """
 
     name: str
@@ -60,7 +62,7 @@ class OperatorSpec:
     use_deviceflow: bool = False
     deviceflow_strategy: str = ""
     inputs: List[str] = dataclasses.field(default_factory=list)
-    custom_fn: Optional[Callable[["SimulationRunner", int, "OperatorSpec"], Dict[str, Any]]] = None
+    custom_fn: Optional[Callable[..., Dict[str, Any]]] = None
 
 
 @dataclasses.dataclass
@@ -76,6 +78,11 @@ class DataPopulation:
     nums: List[int]  # target simulated devices per class
     dynamic_nums: List[int]  # failure allowance per class
     eval_data: Optional[tuple] = None  # (x, y) central eval set
+    # Heterogeneous compute profiles: per-client local-step counts [C]
+    # (padded). None = every client runs config.max_local_steps. This is how
+    # device-tier speed differences (high/mid/low phones) enter the compiled
+    # program — as masked step counts, not separate programs.
+    num_steps: Optional[np.ndarray] = None
 
 
 class SimulationRunner:
@@ -112,6 +119,7 @@ class SimulationRunner:
         self.perf = perf  # PerformanceManager (optional)
         self.stopped = False
         self.states: Dict[str, Any] = {}
+        self._custom_arity: Dict[int, bool] = {}
         # Ditto per-client personal state per population (personalized algos).
         self.personal_states: Dict[str, Any] = {}
         self.history: List[Dict[str, Any]] = []
@@ -197,31 +205,41 @@ class SimulationRunner:
     # -------------------------------------------------------------- operators
     def _run_train(self, p: DataPopulation, round_idx: int,
                    operator: OperatorSpec) -> Dict[str, Any]:
+        # Compile over REAL clients only — released slots must never be
+        # spent on zero-weight padding clients (which would silently shrink
+        # effective participation).
         trace = compile_trace(
             json.loads(operator.deviceflow_strategy) if (
                 operator.use_deviceflow and operator.deviceflow_strategy
             ) else None,
-            p.dataset.num_clients,
+            p.dataset.num_real_clients,
             round_idx,
             task_id=self.task_id,
             operator=operator.name,
             seed=self.trace_seed,
         )
-        participate = global_put(
-            trace.participate, self.core.plan.client_sharding()
-        )
+        mask = np.zeros(p.dataset.num_clients, trace.participate.dtype)
+        mask[: p.dataset.num_real_clients] = trace.participate
+        participate = global_put(mask, self.core.plan.client_sharding())
+        num_steps = None
+        if p.num_steps is not None:
+            num_steps = global_put(
+                np.asarray(p.num_steps, np.int32),
+                self.core.plan.client_sharding(),
+            )
         state = self.states[p.name]
         if self.core.algorithm.personalized:
             personal = self.personal_states.get(p.name)
             if personal is None:
                 personal = self.core.init_personal(state, p.dataset.num_clients)
             state, metrics, personal = self.core.round_step(
-                state, p.dataset, participate=participate, personal=personal
+                state, p.dataset, participate=participate, personal=personal,
+                num_steps=num_steps,
             )
             self.personal_states[p.name] = personal
         else:
             state, metrics = self.core.round_step(
-                state, p.dataset, participate=participate
+                state, p.dataset, participate=participate, num_steps=num_steps
             )
         self.states[p.name] = state
         client_loss = np.asarray(jax.device_get(metrics.client_loss))
@@ -295,6 +313,34 @@ class SimulationRunner:
             round_idx, self.states, self.personal_states, self.history
         )
 
+    def _call_custom(self, operator: OperatorSpec, round_idx: int,
+                     p: DataPopulation) -> Dict[str, Any]:
+        """Invoke a custom operator callback, passing the population when the
+        callback accepts a 4th positional argument (inspected once per
+        callback and cached — catching TypeError at call time would mask
+        errors raised inside the callback)."""
+        fn = operator.custom_fn
+        takes_population = self._custom_arity.get(id(fn))
+        if takes_population is None:
+            import inspect
+
+            try:
+                positional = [
+                    prm for prm in inspect.signature(fn).parameters.values()
+                    if prm.kind in (prm.POSITIONAL_ONLY, prm.POSITIONAL_OR_KEYWORD,
+                                    prm.VAR_POSITIONAL)
+                ]
+                takes_population = (
+                    len(positional) >= 4
+                    or any(prm.kind == prm.VAR_POSITIONAL for prm in positional)
+                )
+            except (TypeError, ValueError):
+                takes_population = True
+            self._custom_arity[id(fn)] = takes_population
+        if takes_population:
+            return fn(self, round_idx, operator, p)
+        return fn(self, round_idx, operator)
+
     # -------------------------------------------------------------------- run
     def run(self) -> List[Dict[str, Any]]:
         for p in self.populations:
@@ -322,12 +368,23 @@ class SimulationRunner:
                 ok_by_population: Dict[str, np.ndarray] = {}
                 op_record: Dict[str, Any] = {}
                 # Only train operators advance clients: eval/custom must not
-                # inflate the device-rounds/sec metric of record.
-                nc = sum(p.dataset.num_real_clients for p in self.populations) \
-                    if operator.kind == "train" else 0
+                # inflate the device-rounds/sec metric of record. Total client
+                # steps honors heterogeneous per-class profiles so per-step
+                # latency is not biased by config.max_local_steps.
+                nc = total_steps = 0
+                if operator.kind == "train":
+                    for p in self.populations:
+                        real = p.dataset.num_real_clients
+                        nc += real
+                        total_steps += (
+                            int(np.sum(p.num_steps[:real]))
+                            if p.num_steps is not None
+                            else real * self.core.config.max_local_steps
+                        )
                 timer = self.perf.time_round(
                     self.task_id, round_idx, operator.name, num_clients=nc,
                     local_steps=self.core.config.max_local_steps,
+                    total_client_steps=total_steps,
                 ) if self.perf is not None else contextlib.nullcontext()
                 with timer:
                     for p in self.populations:
@@ -340,7 +397,7 @@ class SimulationRunner:
                                 p.dataset.num_clients, bool
                             )
                         elif operator.kind == "custom":
-                            r = operator.custom_fn(self, round_idx, operator) or {}
+                            r = self._call_custom(operator, round_idx, p) or {}
                             ok_by_population[p.name] = r.pop(
                                 "ok_mask", np.ones(p.dataset.num_clients, bool)
                             )

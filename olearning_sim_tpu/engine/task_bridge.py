@@ -26,6 +26,7 @@ Arbitrary user code still works through the ``custom`` operator kind
 from __future__ import annotations
 
 import json
+import os
 import threading
 from typing import Any, Dict, Optional
 
@@ -70,10 +71,33 @@ def _operator_specs(tc: pb.TaskConfig) -> list:
             # the TPU engine to run (validation allows this shape).
             continue
         if not info.operatorCodePath.startswith(BUILTIN_PREFIX):
-            raise ValueError(
-                f"operator {op.name}: only builtin: operators are supported by the "
-                f"task bridge; use SimulationRunner directly for custom code"
+            # External user code: stage it (zip or dir) and run it through the
+            # subprocess escape hatch (reference get_operator_code,
+            # utils_runner.py:684-782 + the per-phone subprocess loop).
+            import tempfile
+
+            from olearning_sim_tpu.operators import external_operator_spec
+            from olearning_sim_tpu.storage import (
+                FileTransferType,
+                fetch_operator_code,
+                make_file_repo,
             )
+
+            path = info.operatorCodePath
+            if os.path.isdir(path):
+                code_dir = path
+            else:
+                repo = make_file_repo(FileTransferType(info.operatorTransferType))
+                code_dir = fetch_operator_code(
+                    repo, path, tempfile.mkdtemp(prefix=f"op_{op.name}_")
+                )
+            specs.append(external_operator_spec(
+                name=op.name,
+                code_dir=code_dir,
+                entry_file=info.operatorEntryFile,
+                operator_params=info.operatorParams,
+            ))
+            continue
         kind = info.operatorCodePath[len(BUILTIN_PREFIX):]
         if kind not in ("train", "eval"):
             raise ValueError(f"operator {op.name}: unknown builtin operator {kind!r}")
@@ -110,10 +134,16 @@ def build_runner_from_taskconfig(
     fed_cfg = params.get("fedcore", {})
     data_cfg = params.get("data", {})
 
+    personal_dtype = fed_cfg.get("personal_dtype")
+    if isinstance(personal_dtype, str):
+        import jax.numpy as jnp
+
+        personal_dtype = jnp.dtype(personal_dtype)
     cfg = FedCoreConfig(
         batch_size=int(fed_cfg.get("batch_size", 32)),
         max_local_steps=int(fed_cfg.get("max_local_steps", 10)),
         block_clients=int(fed_cfg.get("block_clients", 64)),
+        personal_dtype=personal_dtype,
     )
     algorithm = algorithm_from_config(algo_cfg.pop("name", "fedavg"), **algo_cfg)
     input_shape = tuple(model_cfg.get("input_shape", [])) or None
@@ -204,6 +234,16 @@ def build_runner_from_taskconfig(
                     int(syn.get("seed", 0)), int(data_cfg["eval_n"]), input_shape,
                     num_classes, class_sep=float(syn.get("class_sep", 2.0)),
                 )
+        # Heterogeneous compute profiles: {"<device_class>": local_steps}
+        # (Ditto/BASELINE config 5); unlisted classes run max_local_steps.
+        profiles = data_cfg.get("compute_profiles") or {}
+        num_steps = None
+        if profiles:
+            steps = np.full(ds.num_clients, cfg.max_local_steps, np.int32)
+            for ci, dev in enumerate(devices):
+                if dev in profiles:
+                    steps[cls == ci] = int(profiles[dev])
+            num_steps = steps
         populations.append(
             DataPopulation(
                 name=td.dataName,
@@ -213,6 +253,7 @@ def build_runner_from_taskconfig(
                 nums=nums,
                 dynamic_nums=dynamic,
                 eval_data=eval_data,
+                num_steps=num_steps,
             )
         )
 

@@ -1,0 +1,18 @@
+"""User operator plugins: the in-process ABC and the subprocess escape hatch.
+
+The reference's core flexibility is arbitrary user operator code shipped as a
+zip and executed per virtual phone via ``python3 {op}/{entry}.py --params
+'<json>'`` (``ols_core/taskMgr/base/base_operator.py:15-63``,
+``utils_run_task.py:496-514``). The rebuild keeps that contract as the *slow
+path* — compiled builtin operators are the fast path — so legacy operators
+run unchanged: same ``--params`` convention, same exit-code success
+accounting.
+"""
+
+from olearning_sim_tpu.operators.base import OperatorABC
+from olearning_sim_tpu.operators.external import (
+    ExternalOperator,
+    external_operator_spec,
+)
+
+__all__ = ["OperatorABC", "ExternalOperator", "external_operator_spec"]

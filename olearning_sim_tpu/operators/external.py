@@ -1,0 +1,125 @@
+"""External operator execution: host subprocesses per client batch.
+
+Reference hot loop being preserved (not replaced): ``Actor.loop_run`` runs
+``python3 {op}/{entry} --params '<json>'`` once per virtual phone and counts
+exit codes (``utils_run_task.py:481-514``). Here the same contract runs per
+*client batch* (batch_size=1 reproduces per-phone granularity) with bounded
+subprocess parallelism replacing the Ray actor pool. The result feeds the
+same ok-mask accounting as the compiled path, so status fusion and
+per-device-class success/failed counts are identical in shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ExternalOperator:
+    """Runs user operator code for every client of every population.
+
+    ``code_dir`` must already contain the operator code (use
+    ``storage.fetch_operator_code`` to stage a zip from any FileRepo).
+    """
+
+    code_dir: str
+    entry_file: str
+    operator_params: str = ""  # opaque JSON string handed to the operator
+    batch_size: int = 1        # clients per subprocess (1 == reference per-phone)
+    max_workers: int = 8       # concurrent subprocesses (the actor-pool analogue)
+    timeout_s: float = 300.0
+    python_exe: str = sys.executable
+    save_dir: Optional[str] = None  # scratch; per-run tempdir when None
+
+    def __post_init__(self):
+        entry = os.path.join(self.code_dir, self.entry_file)
+        if not os.path.isfile(entry):
+            raise FileNotFoundError(f"operator entry not found: {entry}")
+
+    # ------------------------------------------------------------------ batch
+    def _batch_params(self, task_id: str, round_idx: int, operator_name: str,
+                      population_name: str, client_ids: List[int],
+                      save_dir: str) -> Dict[str, Any]:
+        """Per-batch params in the reference schema
+        (``base_operator.py:15-52``)."""
+        try:
+            parsed = json.loads(self.operator_params) if self.operator_params else {}
+        except json.JSONDecodeError:
+            parsed = {}
+        return {
+            "task_id": task_id,
+            "current_round": round_idx,
+            "data": {"name": population_name},
+            "operator": {
+                "name": operator_name,
+                "operator_params": self.operator_params,
+            },
+            "client_ids": client_ids,
+            "actor_save_dir": save_dir,
+            "actor_simulation_num": len(client_ids),
+            "params": parsed,
+        }
+
+    def _run_batch(self, params: Dict[str, Any]) -> bool:
+        cmd = [self.python_exe, os.path.join(self.code_dir, self.entry_file),
+               "--params", json.dumps(params)]
+        try:
+            proc = subprocess.run(
+                cmd, cwd=self.code_dir, timeout=self.timeout_s,
+                capture_output=True,
+            )
+            return proc.returncode == 0
+        except (subprocess.TimeoutExpired, OSError):
+            return False
+
+    # -------------------------------------------------------------- operator
+    def __call__(self, runner, round_idx: int, operator, population) -> Dict[str, Any]:
+        """OperatorSpec.custom_fn: advance one population's clients through
+        the external code; the returned ok_mask feeds analyze_results (the
+        exit-code accounting of ``utils_run_task.py:490-494``)."""
+        save_root = self.save_dir or tempfile.mkdtemp(prefix="ext_op_")
+        p = population
+        real = p.dataset.num_real_clients
+        ok = np.zeros(p.dataset.num_clients, bool)
+        batches = [
+            list(range(s, min(s + self.batch_size, real)))
+            for s in range(0, real, self.batch_size)
+        ]
+        params_list = [
+            self._batch_params(
+                runner.task_id, round_idx, operator.name, p.name, b,
+                os.path.join(save_root, f"{p.name}_batch{bi}"),
+            )
+            for bi, b in enumerate(batches)
+        ]
+        with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            results = list(pool.map(self._run_batch, params_list))
+        for b, success in zip(batches, results):
+            ok[b] = success
+        success_n = int(ok[:real].sum())
+        return {"ok_mask": ok, "success": success_n, "failed": real - success_n}
+
+
+def external_operator_spec(name: str, code_dir: str, entry_file: str,
+                           operator_params: str = "", **kwargs):
+    """Build an OperatorSpec running external user code (the task-bridge
+    path for non-``builtin:`` operatorCodePath values)."""
+    from olearning_sim_tpu.engine.runner import OperatorSpec
+
+    return OperatorSpec(
+        name=name,
+        kind="custom",
+        custom_fn=ExternalOperator(
+            code_dir=code_dir, entry_file=entry_file,
+            operator_params=operator_params, **kwargs,
+        ),
+    )

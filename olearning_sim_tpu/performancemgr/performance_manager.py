@@ -29,6 +29,10 @@ class RoundTiming:
     duration_s: float
     num_clients: int = 0
     local_steps: int = 0
+    # Actual total (client, step) pairs executed; overrides the
+    # num_clients * local_steps estimate when heterogeneous compute profiles
+    # give clients differing step counts.
+    total_client_steps: int = 0
     extra: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
@@ -40,8 +44,17 @@ class RoundTiming:
         """Amortized wall time per (client, local step) — the per-device-step
         cost the reference models as alpha=3.5 s/device-round on CPU actors
         (``utils_runner.py:941``)."""
-        steps = self.num_clients * max(self.local_steps, 1)
+        steps = self.total_client_steps or self.num_clients * max(self.local_steps, 1)
         return self.duration_s / steps if steps else 0.0
+
+
+def _mean_step_latency(rows: List["RoundTiming"]) -> float:
+    """Mean over client-advancing rows only: eval/custom rows (num_clients=0)
+    contribute no steps and must not dilute the metric of record."""
+    train_rows = [t for t in rows if t.num_clients > 0]
+    if not train_rows:
+        return 0.0
+    return sum(t.per_client_step_latency_s for t in train_rows) / len(train_rows)
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -86,9 +99,10 @@ class PerformanceManager:
     class _Timer:
         def __init__(self, mgr: "PerformanceManager", task_id: str,
                      round_idx: int, operator: str, num_clients: int,
-                     local_steps: int):
+                     local_steps: int, total_client_steps: int):
             self._mgr = mgr
-            self._args = (task_id, round_idx, operator, num_clients, local_steps)
+            self._args = (task_id, round_idx, operator, num_clients,
+                          local_steps, total_client_steps)
 
         def __enter__(self):
             self._t0 = time.perf_counter()
@@ -96,19 +110,21 @@ class PerformanceManager:
 
         def __exit__(self, exc_type, exc, tb):
             if exc_type is None:
-                task_id, round_idx, operator, nc, ls = self._args
+                task_id, round_idx, operator, nc, ls, tcs = self._args
                 self._mgr.record_round(RoundTiming(
                     task_id=task_id, round_idx=round_idx, operator=operator,
                     duration_s=time.perf_counter() - self._t0,
-                    num_clients=nc, local_steps=ls,
+                    num_clients=nc, local_steps=ls, total_client_steps=tcs,
                 ))
             return False
 
     def time_round(self, task_id: str, round_idx: int, operator: str,
-                   num_clients: int = 0, local_steps: int = 0) -> "_Timer":
+                   num_clients: int = 0, local_steps: int = 0,
+                   total_client_steps: int = 0) -> "_Timer":
         """``with perf.time_round(...):`` around one operator execution."""
         return PerformanceManager._Timer(
-            self, task_id, round_idx, operator, num_clients, local_steps
+            self, task_id, round_idx, operator, num_clients, local_steps,
+            total_client_steps,
         )
 
     # --------------------------------------------------------------- queries
@@ -136,9 +152,7 @@ class PerformanceManager:
                 "p95": _percentile(durations, 0.95),
                 "max": durations[-1],
             },
-            "per_client_step_latency_s": (
-                sum(t.per_client_step_latency_s for t in rows) / len(rows)
-            ),
+            "per_client_step_latency_s": _mean_step_latency(rows),
         }
 
     def list_tasks(self) -> List[str]:

@@ -1,0 +1,156 @@
+"""External operator escape hatch: subprocess execution + exit-code accounting."""
+
+import json
+import os
+import textwrap
+import zipfile
+
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.engine.runner import DataPopulation, OperatorSpec, SimulationRunner
+from olearning_sim_tpu.operators import ExternalOperator, external_operator_spec
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+OP_OK = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo_root!r})
+    from olearning_sim_tpu.operators import OperatorABC
+
+    class MyOp(OperatorABC):
+        def run(self):
+            # Record the params we got so the test can inspect them.
+            out = os.path.join({outdir!r}, f"call_{{self.params['current_round']}}_"
+                               f"{{self.params['client_ids'][0]}}.json")
+            with open(out, "w") as f:
+                json.dump(self.params, f)
+            return 0
+
+    MyOp().main()
+""")
+
+OP_FAIL_ODD = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo_root!r})
+    from olearning_sim_tpu.operators import OperatorABC
+
+    class MyOp(OperatorABC):
+        def run(self):
+            # Fail for odd client ids (exit-code fault injection).
+            return 1 if self.params["client_ids"][0] % 2 else 0
+
+    MyOp().main()
+""")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_op(tmp_path, source, **fmt):
+    code_dir = tmp_path / "opcode"
+    code_dir.mkdir(exist_ok=True)
+    (code_dir / "entry.py").write_text(source.format(repo_root=REPO_ROOT, **fmt))
+    return str(code_dir)
+
+
+@pytest.fixture(scope="module")
+def sim(tmp_path_factory):
+    plan = make_mesh_plan()
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (16,), "num_classes": 4},
+        input_shape=(12,),
+    )
+    ds = make_synthetic_dataset(
+        seed=1, num_clients=8, n_local=4, input_shape=(12,), num_classes=4
+    ).pad_for(plan, 2).place(plan)
+    pop = DataPopulation(
+        name="data_0", dataset=ds, device_classes=["hpc"],
+        class_of_client=np.zeros(ds.num_clients, int),
+        nums=[8], dynamic_nums=[4],
+    )
+    return core, pop
+
+
+def test_external_operator_runs_user_code(tmp_path, sim):
+    core, pop = sim
+    outdir = tmp_path / "calls"
+    outdir.mkdir()
+    code_dir = _write_op(tmp_path, OP_OK, outdir=str(outdir))
+    spec = external_operator_spec("ext", code_dir, "entry.py",
+                                  operator_params=json.dumps({"lr": 0.5}))
+    runner = SimulationRunner(
+        task_id="ext-task", core=core, populations=[pop],
+        operators=[spec], rounds=2,
+    )
+    history = runner.run()
+    assert history[0]["ext"]["data_0"]["success"] == 8
+    assert history[0]["ext"]["data_0"]["failed"] == 0
+    # One subprocess call per client per round (batch_size=1).
+    calls = sorted(os.listdir(outdir))
+    assert len(calls) == 16
+    params = json.load(open(outdir / calls[0]))
+    assert params["task_id"] == "ext-task"
+    assert params["operator"]["name"] == "ext"
+    assert params["params"] == {"lr": 0.5}
+    assert params["actor_simulation_num"] == 1
+
+
+def test_exit_codes_feed_accounting(tmp_path, sim):
+    core, pop = sim
+    code_dir = _write_op(tmp_path, OP_FAIL_ODD)
+    spec = external_operator_spec("flaky", code_dir, "entry.py")
+    runner = SimulationRunner(
+        task_id="flaky-task", core=core, populations=[pop],
+        operators=[spec], rounds=1,
+    )
+    history = runner.run()
+    assert history[0]["flaky"]["data_0"]["success"] == 4
+    assert history[0]["flaky"]["data_0"]["failed"] == 4
+    # Per-class failed counts persisted (odd ids failed).
+    blob = json.loads(
+        runner.task_repo.get_item_value("flaky-task", "logical_result")
+    )["logical_result"]
+    assert blob[0]["simulation_target"]["failed_num"] == [4]
+
+
+def test_batched_execution(tmp_path, sim):
+    core, pop = sim
+    outdir = tmp_path / "calls_b"
+    outdir.mkdir()
+    code_dir = _write_op(tmp_path, OP_OK, outdir=str(outdir))
+    op = ExternalOperator(code_dir=code_dir, entry_file="entry.py", batch_size=4)
+    spec = OperatorSpec(name="ext", kind="custom", custom_fn=op)
+    runner = SimulationRunner(
+        task_id="batch-task", core=core, populations=[pop],
+        operators=[spec], rounds=1,
+    )
+    runner.run()
+    assert len(os.listdir(outdir)) == 2  # 8 clients / batch_size 4
+
+
+def test_missing_entry_rejected(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ExternalOperator(code_dir=str(tmp_path), entry_file="ghost.py")
+
+
+def test_task_bridge_external_operator(tmp_path, sim):
+    """Non-builtin operatorCodePath routes through the escape hatch."""
+    from olearning_sim_tpu.engine.task_bridge import build_runner_from_taskconfig
+    from tests.test_taskmgr import make_task_json
+
+    code_dir = _write_op(tmp_path, OP_FAIL_ODD)
+    tj = make_task_json("bridge-ext", rounds=1, num_clients=8)
+    ops = tj["operatorflow"]["operators"]
+    ext = json.loads(json.dumps(ops[0]))  # deep copy of the train operator
+    ext["name"] = "legacy"
+    ext["logical_simulation"]["operator_code_path"] = code_dir
+    ext["logical_simulation"]["operator_entry_file"] = "entry.py"
+    ext["logical_simulation"]["operator_params"] = ""
+    ops.append(ext)
+    runner = build_runner_from_taskconfig(json.dumps(tj))
+    history = runner.run()
+    assert history[0]["legacy"]["data_0"]["success"] == 4
+    assert history[0]["legacy"]["data_0"]["failed"] == 4
