@@ -325,14 +325,18 @@ class SimulationRunner:
             import inspect
 
             try:
-                positional = [
-                    prm for prm in inspect.signature(fn).parameters.values()
-                    if prm.kind in (prm.POSITIONAL_ONLY, prm.POSITIONAL_OR_KEYWORD,
-                                    prm.VAR_POSITIONAL)
+                params = inspect.signature(fn).parameters.values()
+                # Count only REQUIRED positional params: a legacy 3-arg
+                # callback with an optional 4th keyword (verbose=False) must
+                # not have a DataPopulation shoved into it.
+                required = [
+                    prm for prm in params
+                    if prm.kind in (prm.POSITIONAL_ONLY, prm.POSITIONAL_OR_KEYWORD)
+                    and prm.default is prm.empty
                 ]
                 takes_population = (
-                    len(positional) >= 4
-                    or any(prm.kind == prm.VAR_POSITIONAL for prm in positional)
+                    len(required) >= 4
+                    or any(prm.kind == prm.VAR_POSITIONAL for prm in params)
                 )
             except (TypeError, ValueError):
                 takes_population = True

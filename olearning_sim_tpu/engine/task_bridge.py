@@ -96,6 +96,11 @@ def _operator_specs(tc: pb.TaskConfig) -> list:
                 code_dir=code_dir,
                 entry_file=info.operatorEntryFile,
                 operator_params=info.operatorParams,
+                use_deviceflow=op.operationBehaviorController.useController,
+                deviceflow_strategy=(
+                    op.operationBehaviorController.strategyBehaviorController
+                ),
+                inputs=list(op.input),
             ))
             continue
         kind = info.operatorCodePath[len(BUILTIN_PREFIX):]

@@ -45,6 +45,23 @@ class TransformerBlock(nn.Module):
             y = RingSelfAttention(
                 num_heads=self.heads, dtype=self.dtype
             )(x, pad_mask)
+        elif self.attention_impl == "flash":
+            # Fused Pallas kernel: no HBM score tensor. Slower than XLA's
+            # fused dense path on current chips (see ops/flash_attention.py);
+            # exists as the ring per-step primitive and for variants XLA
+            # can't fuse.
+            from olearning_sim_tpu.ops import flash_attention
+
+            B, L, W = x.shape
+            head_dim = W // self.heads
+            qkv = nn.DenseGeneral(
+                features=(3, self.heads, head_dim), axis=-1, dtype=self.dtype,
+                name="qkv",
+            )(x)
+            q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+            o = flash_attention(q, k, v, kv_mask=pad_mask)
+            o = jnp.moveaxis(o, 1, 2).reshape(B, L, W)
+            y = nn.Dense(W, dtype=self.dtype, name="attn_out")(o)
         else:
             attn_mask = nn.make_attention_mask(pad_mask, pad_mask, dtype=self.dtype)
             y = nn.MultiHeadDotProductAttention(

@@ -44,6 +44,23 @@ class ExternalOperator:
         entry = os.path.join(self.code_dir, self.entry_file)
         if not os.path.isfile(entry):
             raise FileNotFoundError(f"operator entry not found: {entry}")
+        # Parse operator_params once, at build time: a malformed JSON blob
+        # must fail the task here, not silently train with defaults.
+        if self.operator_params:
+            try:
+                self._parsed_params = json.loads(self.operator_params)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"operator_params is not valid JSON: {e}"
+                ) from e
+        else:
+            self._parsed_params = {}
+        if self.save_dir is None:
+            # One stable scratch root per operator instance (reference
+            # actor_save_dir is per-actor and stable across rounds,
+            # utils_run_task.py:430-479); per-batch subdirs are reused each
+            # round instead of leaking a tempdir per round.
+            self.save_dir = tempfile.mkdtemp(prefix="ext_op_")
 
     # ------------------------------------------------------------------ batch
     def _batch_params(self, task_id: str, round_idx: int, operator_name: str,
@@ -51,10 +68,7 @@ class ExternalOperator:
                       save_dir: str) -> Dict[str, Any]:
         """Per-batch params in the reference schema
         (``base_operator.py:15-52``)."""
-        try:
-            parsed = json.loads(self.operator_params) if self.operator_params else {}
-        except json.JSONDecodeError:
-            parsed = {}
+        os.makedirs(save_dir, exist_ok=True)
         return {
             "task_id": task_id,
             "current_round": round_idx,
@@ -66,7 +80,7 @@ class ExternalOperator:
             "client_ids": client_ids,
             "actor_save_dir": save_dir,
             "actor_simulation_num": len(client_ids),
-            "params": parsed,
+            "params": self._parsed_params,
         }
 
     def _run_batch(self, params: Dict[str, Any]) -> bool:
@@ -86,7 +100,7 @@ class ExternalOperator:
         """OperatorSpec.custom_fn: advance one population's clients through
         the external code; the returned ok_mask feeds analyze_results (the
         exit-code accounting of ``utils_run_task.py:490-494``)."""
-        save_root = self.save_dir or tempfile.mkdtemp(prefix="ext_op_")
+        save_root = self.save_dir
         p = population
         real = p.dataset.num_real_clients
         ok = np.zeros(p.dataset.num_clients, bool)
@@ -110,14 +124,22 @@ class ExternalOperator:
 
 
 def external_operator_spec(name: str, code_dir: str, entry_file: str,
-                           operator_params: str = "", **kwargs):
+                           operator_params: str = "",
+                           use_deviceflow: bool = False,
+                           deviceflow_strategy: str = "",
+                           inputs=None, **kwargs):
     """Build an OperatorSpec running external user code (the task-bridge
-    path for non-``builtin:`` operatorCodePath values)."""
+    path for non-``builtin:`` operatorCodePath values). Deviceflow lifecycle
+    flags carry over so legacy operators keep their NotifyStart/Complete
+    semantics."""
     from olearning_sim_tpu.engine.runner import OperatorSpec
 
     return OperatorSpec(
         name=name,
         kind="custom",
+        use_deviceflow=use_deviceflow,
+        deviceflow_strategy=deviceflow_strategy,
+        inputs=list(inputs or []),
         custom_fn=ExternalOperator(
             code_dir=code_dir, entry_file=entry_file,
             operator_params=operator_params, **kwargs,

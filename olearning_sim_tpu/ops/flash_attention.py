@@ -1,0 +1,147 @@
+"""Fused (flash-style) self-attention Pallas kernel.
+
+One kernel instance handles one (batch*head, q-block): it streams the whole
+local K/V chunk through VMEM and produces the attention output without ever
+writing the [Lq, Lk] score matrix to HBM. Sequence lengths here are the
+*per-device* chunk (ring attention shards the global sequence over devices
+and calls this per step), so K/V fitting VMEM is by construction.
+
+Numerically: scores and softmax accumulate in f32 regardless of input dtype
+(bf16 inputs hit the MXU for both matmuls, f32 for the reductions).
+Padding: key-side padding enters as a 0/1 mask; fully-masked query rows
+(q-padding) produce 0 output via the l-guard.
+
+Measured position (single v5e-class chip, bf16, H=12 D=64): XLA's fused
+dense attention is faster at every L tested (10 ms vs 52 ms at L=2048) —
+XLA's attention fusion on TPU is already excellent, and this workload's
+sequences are short. This kernel's role is (a) the per-step primitive for
+ring attention, where K/V chunks are VMEM-resident by construction, and
+(b) a fusion point for attention variants XLA can't fuse (e.g. quantized
+KV). Use ``attention_impl='dense'`` for raw speed.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds too; guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    _VMEM = None
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, *, scale):
+    # Matmul operands stay in the input dtype (bf16 hits the fast MXU path);
+    # accumulation and softmax are f32 via preferred_element_type.
+    q = q_ref[0]                             # [bq, D]
+    k = k_ref[0]                             # [Lk, D]
+    v = v_ref[0]                             # [Lk, D]
+    kmask = kmask_ref[0].astype(jnp.float32)  # [1, Lk]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                # [bq, Lk] f32
+    s = s + (1.0 - kmask) * NEG_INF          # broadcast over q rows
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # Guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1 and attend
+    # uniformly to padding; pin m to 0 there so p underflows to 0 instead.
+    m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o = o / jnp.maximum(l, 1e-20)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _pad_to(x, axis: int, multiple: int):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_q", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Self-attention ``softmax(q k^T / sqrt(D)) v`` without HBM scores.
+
+    Args:
+      q: [B, H, Lq, D]
+      k, v: [B, H, Lk, D]
+      kv_mask: [B, Lk] bool/0-1, True = real key (padding mask); None = all.
+      interpret: run the Pallas interpreter instead of Mosaic; default
+        auto-selects the interpreter on non-TPU backends (CPU CI).
+
+    Returns [B, H, Lq, D] in q's dtype.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, Lk), jnp.float32)
+    kv_mask = kv_mask.astype(jnp.float32)
+
+    # Hardware alignment: lanes = 128 on the last dim, pad q-rows to the
+    # q-block and keys to the sublane multiple. Zero-padded D contributes
+    # nothing to dot products; padded keys are masked; padded q rows are
+    # sliced off below.
+    bq = min(block_q, max(8, 1 << (Lq - 1).bit_length()))
+    qp = _pad_to(_pad_to(q, 3, 128), 2, bq)
+    kp = _pad_to(_pad_to(k, 3, 128), 2, 8)
+    vp = _pad_to(_pad_to(v, 3, 128), 2, 8)
+    maskp = _pad_to(kv_mask, 1, 8)
+    Dp, Lqp, Lkp = qp.shape[3], qp.shape[2], kp.shape[2]
+
+    qf = qp.reshape(B * H, Lqp, Dp)
+    kf = kp.reshape(B * H, Lkp, Dp)
+    vf = vp.reshape(B * H, Lkp, Dp)
+    # Mask is per-batch; expand to per-(batch*head) and insert a unit sublane
+    # dim: a [1, 1, Lkp] block is tile-legal because both trailing block dims
+    # equal the array dims (a bare [1, Lkp] block is not).
+    maskf = jnp.repeat(maskp, H, axis=0)[:, None, :]  # [B*H, 1, Lkp]
+
+    grid = (B * H, Lqp // bq)
+    kwargs = dict(memory_space=_VMEM) if _VMEM is not None else {}
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lqp, Dp), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0), **kwargs),
+            pl.BlockSpec((1, Lkp, Dp), lambda b, i: (b, 0, 0), **kwargs),
+            pl.BlockSpec((1, Lkp, Dp), lambda b, i: (b, 0, 0), **kwargs),
+            pl.BlockSpec((1, 1, Lkp), lambda b, i: (b, 0, 0), **kwargs),
+        ],
+        out_specs=pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0), **kwargs),
+        interpret=interpret,
+    )(qf, kf, vf, maskf)
+    return out.reshape(B, H, Lqp, Dp)[:, :, :Lq, :D]
