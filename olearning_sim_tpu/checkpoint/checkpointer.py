@@ -165,26 +165,38 @@ class ModelUpdateExporter:
 
     def export(self, round_idx: int, params: Any) -> str:
         import os
+        import tempfile
 
         name = self._name(round_idx)
         os.makedirs(self.scratch_dir, exist_ok=True)
-        local = os.path.join(self.scratch_dir, name)
-        with open(local, "wb") as f:
-            f.write(export_model_bytes(params))
-        if not self.repo.upload_file(local, name):
-            raise IOError(f"model export failed: {name}")
-        os.remove(local)
+        # mkstemp, not a fixed path: a concurrent exporter/loader for the same
+        # task+round (or a pre-created file on a shared host) must never see a
+        # partially written or clobbered staging file.
+        fd, local = tempfile.mkstemp(prefix=name + ".", dir=self.scratch_dir)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(export_model_bytes(params))
+            if not self.repo.upload_file(local, name):
+                raise IOError(f"model export failed: {name}")
+        finally:
+            if os.path.exists(local):
+                os.remove(local)
         return name
 
     def load(self, round_idx: int, template: Any) -> Any:
         import os
+        import tempfile
 
         name = self._name(round_idx)
         os.makedirs(self.scratch_dir, exist_ok=True)
-        local = os.path.join(self.scratch_dir, name)
-        if not self.repo.download_file(name, local):
-            raise FileNotFoundError(f"round model not found: {name}")
-        with open(local, "rb") as f:
-            data = f.read()
-        os.remove(local)
+        fd, local = tempfile.mkstemp(prefix=name + ".", dir=self.scratch_dir)
+        os.close(fd)
+        try:
+            if not self.repo.download_file(name, local):
+                raise FileNotFoundError(f"round model not found: {name}")
+            with open(local, "rb") as f:
+                data = f.read()
+        finally:
+            if os.path.exists(local):
+                os.remove(local)
         return import_model_bytes(template, data)

@@ -28,6 +28,7 @@ import contextlib
 import dataclasses
 import json
 import threading
+import zlib
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -349,8 +350,12 @@ class SimulationRunner:
     def run(self) -> List[Dict[str, Any]]:
         for p in self.populations:
             if p.name not in self.states:
+                # crc32, not hash(): str hashes are PYTHONHASHSEED-randomized
+                # per process, which would silently diverge the "replicated"
+                # ServerState across multi-controller processes (and break
+                # restart reproducibility). Same pattern as phone_farm.py.
                 self.states[p.name] = self.core.init_state(
-                    jax.random.key(hash(self.task_id) & 0x7FFFFFFF)
+                    jax.random.key(zlib.crc32(self.task_id.encode()) & 0x7FFFFFFF)
                 )
         start_round = self._try_resume()
 

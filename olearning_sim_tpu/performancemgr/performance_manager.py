@@ -93,7 +93,13 @@ class PerformanceManager:
                 "duration_s": [repr(timing.duration_s)],
                 "num_clients": [str(timing.num_clients)],
                 "local_steps": [str(timing.local_steps)],
-                "extra": [json.dumps(timing.extra)],
+                # total_client_steps rides in the extra JSON (no schema change)
+                # so heterogeneous-profile per-client step latency stays
+                # recomputable from a persisted repo, not just in memory.
+                "extra": [json.dumps(
+                    {**timing.extra,
+                     "total_client_steps": timing.total_client_steps}
+                )],
             })
 
     class _Timer:

@@ -20,6 +20,7 @@ import abc
 import enum
 import os
 import shutil
+import tempfile
 import urllib.request
 import zipfile
 from typing import List, Optional
@@ -84,7 +85,15 @@ class LocalFileRepo(FileRepo):
         try:
             dest = self._resolve(remote_path)
             os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
-            shutil.copyfile(local_path, dest)
+            # Stage-then-rename: a concurrent reader of ``dest`` must never
+            # see a half-copied file (os.replace is atomic within one fs);
+            # unique staging name so two uploaders don't clobber each other.
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(dest) + ".", dir=os.path.dirname(dest) or "."
+            )
+            os.close(fd)
+            shutil.copyfile(local_path, tmp)
+            os.replace(tmp, dest)
             return True
         except OSError:
             return False
@@ -116,6 +125,14 @@ class LocalFileRepo(FileRepo):
         if os.path.isfile(base):
             return [prefix]
         search_root = base if os.path.isdir(base) else (os.path.dirname(base) or ".")
+        if os.path.abspath(search_root) == os.path.sep:
+            # A filesystem-rooted walk from "/" (root="/" with an empty or
+            # one-level prefix) would scan the entire host. Demand intent.
+            raise ValueError(
+                "LocalFileRepo.list_files would walk the whole filesystem "
+                f"(root={self.root!r}, prefix={prefix!r}); construct the repo "
+                "with an explicit root directory instead"
+            )
         if not os.path.isdir(search_root):
             return []
         for dirpath, _dirs, files in os.walk(search_root):
@@ -246,6 +263,19 @@ class MinioFileRepo(FileRepo):
             return []
 
 
+def storage_settings_from_env() -> dict:
+    """Object-store connection settings from the environment (the reference
+    reads them from ``config/manager_config.yaml``; the deployment config
+    system maps that file onto these variables)."""
+    return {
+        "endpoint": os.environ.get("OLS_STORAGE_ENDPOINT", ""),
+        "access_key": os.environ.get("OLS_STORAGE_ACCESS_KEY", ""),
+        "secret_key": os.environ.get("OLS_STORAGE_SECRET_KEY", ""),
+        "bucket": os.environ.get("OLS_STORAGE_BUCKET", ""),
+        "secure": os.environ.get("OLS_STORAGE_SECURE", "") == "1",
+    }
+
+
 def make_file_repo(transfer_type: FileTransferType, *, root: str = "/",
                    endpoint: str = "", access_key: str = "", secret_key: str = "",
                    bucket: str = "", secure: bool = False) -> FileRepo:
@@ -256,6 +286,20 @@ def make_file_repo(transfer_type: FileTransferType, *, root: str = "/",
         return LocalFileRepo(root=root)
     if t == FileTransferType.HTTP:
         return HttpFileRepo()
+    if t in (FileTransferType.S3, FileTransferType.MINIO) and not endpoint:
+        env = storage_settings_from_env()
+        if not env["endpoint"]:
+            raise ValueError(
+                f"{t.name} transfer type needs object-store settings; pass "
+                "endpoint/keys/bucket or set OLS_STORAGE_ENDPOINT / "
+                "OLS_STORAGE_ACCESS_KEY / OLS_STORAGE_SECRET_KEY / "
+                "OLS_STORAGE_BUCKET"
+            )
+        endpoint = env["endpoint"]
+        access_key = access_key or env["access_key"]
+        secret_key = secret_key or env["secret_key"]
+        bucket = bucket or env["bucket"]
+        secure = secure or env["secure"]
     if t == FileTransferType.S3:
         return S3FileRepo(endpoint_url=endpoint, access_key=access_key,
                           secret_key=secret_key, bucket=bucket)
