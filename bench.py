@@ -1,13 +1,16 @@
 """Headline benchmark: FL rounds/sec simulating 10k clients, 4-layer CNN on
 CIFAR-10-shaped data (BASELINE.md: >=500 rounds/min over 10k clients on a
-v4-32, i.e. ~0.26 rounds/sec per chip).
+v4-32).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 ``vs_baseline`` is measured per-chip rounds/sec divided by the reference
-target's per-chip rounds/sec (500/60/32), so >1.0 means beating the v4-32
-target on a chip-for-chip basis.
+target's per-chip rounds/sec. Per-chip math, stated explicitly: a v4-32 is
+32 TensorCores = **16 chips** (2 cores/chip), so the target pro-rates to
+500/60/16 = 0.521 rounds/sec per chip; >1.0 means beating the v4-32 target
+chip-for-chip (ignoring that v4 has ~1.4x the bf16 peak of the v5e this
+runs on — the conservative direction).
 """
 
 import json
@@ -25,7 +28,8 @@ from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_datas
 from olearning_sim_tpu.engine.fedcore import FedCoreConfig
 from olearning_sim_tpu.parallel.mesh import make_mesh_plan
 
-BASELINE_ROUNDS_PER_SEC_PER_CHIP = 500.0 / 60.0 / 32.0  # BASELINE.md target
+V4_32_CHIPS = 16  # 32 TensorCores / 2 cores per chip
+BASELINE_ROUNDS_PER_SEC_PER_CHIP = 500.0 / 60.0 / V4_32_CHIPS
 
 
 def main():
@@ -75,6 +79,8 @@ def main():
         "detail": {
             "device_rounds_per_sec": round(num_clients * rounds_per_sec, 1),
             "chips": n_chips,
+            "baseline_chips_v4_32": V4_32_CHIPS,
+            "baseline_rounds_per_sec_per_chip": round(BASELINE_ROUNDS_PER_SEC_PER_CHIP, 4),
             "backend": jax.default_backend(),
             "round_time_sec": round(dt / timed_rounds, 4),
             "mean_loss": last_loss,

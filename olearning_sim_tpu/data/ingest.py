@@ -1,0 +1,135 @@
+"""Task-data ingestion: ``dataPath`` + ``dataTransferType`` -> placed population.
+
+Reference behavior being matched (``ols_core/taskMgr/utils/utils_run_task.py:
+174-325`` ``download_data_files``): each actor downloads the task's archive
+via FILE/HTTP/S3/MINIO, unzips it, and feeds per-phone files to operator
+subprocesses. Here ingestion happens once per task: fetch archive -> parse
+the standard dataset format (:mod:`formats`) -> partition into the
+rectangular client population (:mod:`partition`). The fetched/parsed arrays
+are cached per (path, split) so multi-operator tasks don't re-download.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import zipfile
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from olearning_sim_tpu.data import formats
+from olearning_sim_tpu.data.partition import partition
+
+_cache: Dict[Tuple[str, str], Any] = {}
+_cache_lock = threading.Lock()
+
+
+def fetch_dataset_dir(
+    data_path: str,
+    transfer_type: Any = None,
+    storage_settings: Optional[dict] = None,
+) -> str:
+    """Materialize ``data_path`` as a local directory.
+
+    - local directory -> itself
+    - local/remote ``.zip`` -> fetched (FileRepo for non-FILE transfer
+      types), extracted into a temp dir (zip-slip-guarded), nested-once
+      roots flattened by :func:`formats.detect_and_load`.
+    """
+    if os.path.isdir(data_path):
+        return data_path
+    local_zip = data_path
+    is_remote = transfer_type is not None and getattr(transfer_type, "name", str(transfer_type)) not in ("FILE", "0")
+    if is_remote or not os.path.exists(data_path):
+        from olearning_sim_tpu.storage import FileTransferType, make_file_repo
+
+        tt = transfer_type if transfer_type is not None else FileTransferType.FILE
+        repo = make_file_repo(FileTransferType(int(tt)) if isinstance(tt, int) else tt,
+                              **(storage_settings or {}))
+        local_zip = os.path.join(tempfile.mkdtemp(prefix="olsdata_"), os.path.basename(data_path))
+        if not repo.download_file(data_path, local_zip):
+            raise FileNotFoundError(f"could not fetch dataset {data_path!r} via {tt}")
+    if not zipfile.is_zipfile(local_zip):
+        raise ValueError(f"dataset path {data_path!r} is neither a directory nor a zip archive")
+    out = tempfile.mkdtemp(prefix="olsdata_x_")
+    with zipfile.ZipFile(local_zip) as zf:
+        for m in zf.namelist():
+            target = os.path.realpath(os.path.join(out, m))
+            if not target.startswith(os.path.realpath(out) + os.sep):
+                raise ValueError(f"zip entry escapes extraction root: {m!r}")
+        zf.extractall(out)
+    return out
+
+
+def load_arrays(
+    data_path: str,
+    split: str = "train",
+    transfer_type: Any = None,
+    storage_settings: Optional[dict] = None,
+    **text_kwargs,
+) -> formats.Parsed:
+    """Fetch + parse with per-(path, split) caching."""
+    key = (data_path, split)
+    with _cache_lock:
+        if key in _cache:
+            return _cache[key]
+    d = fetch_dataset_dir(data_path, transfer_type, storage_settings)
+    parsed = formats.detect_and_load(d, split, **text_kwargs)
+    with _cache_lock:
+        _cache[key] = parsed
+    return parsed
+
+
+def clear_cache() -> None:
+    with _cache_lock:
+        _cache.clear()
+
+
+def load_population(
+    data_path: str,
+    num_clients: int,
+    n_local: int,
+    scheme: str = "dirichlet",
+    alpha: float = 0.5,
+    seed: int = 0,
+    transfer_type: Any = None,
+    storage_settings: Optional[dict] = None,
+    eval_split: str = "test",
+    eval_n: Optional[int] = None,
+    **text_kwargs,
+):
+    """Full ingestion: returns ``(ClientDataset, (eval_x, eval_y) | None,
+    num_classes)``. The eval set comes from the archive's test split when
+    present, else a held-out tail of train (deterministic, disjoint from
+    every client shard by construction: holdout rows are removed before
+    partitioning)."""
+    x, y, writer = load_arrays(
+        data_path, "train", transfer_type, storage_settings, **text_kwargs
+    )
+    eval_data = None
+    try:
+        ex, ey, _ = load_arrays(
+            data_path, eval_split, transfer_type, storage_settings, **text_kwargs
+        )
+        eval_data = (ex, ey)
+    except (FileNotFoundError, KeyError):
+        if eval_n:
+            hold = min(int(eval_n), len(y) // 5)
+            rng = np.random.default_rng([seed, 0xE7A1])
+            hold_idx = rng.choice(len(y), size=hold, replace=False)
+            mask = np.ones(len(y), bool)
+            mask[hold_idx] = False
+            eval_data = (x[hold_idx], y[hold_idx])
+            x, y = x[mask], y[mask]
+            if writer is not None:
+                writer = writer[mask]
+    if eval_data is not None and eval_n:
+        eval_data = (eval_data[0][: int(eval_n)], eval_data[1][: int(eval_n)])
+    ds = partition(
+        x, y, num_clients, n_local,
+        scheme=scheme, alpha=alpha, writer=writer, seed=seed,
+    )
+    num_classes = int(np.max(y)) + 1 if len(y) else 0
+    return ds, eval_data, num_classes

@@ -85,17 +85,24 @@ class ClientDataset:
             num_real_clients=self.num_real_clients,
         )
 
-    def place(self, plan: MeshPlan) -> "ClientDataset":
+    def place(self, plan: MeshPlan, feature_dtype=jnp.bfloat16) -> "ClientDataset":
         """Move arrays to devices, client axis sharded over ``dp``.
 
         Host arrays go straight to their shards (no staging of the full
         population on one device — matters once the population only fits
-        sharded).
+        sharded). Floating-point features are stored in ``feature_dtype``
+        (default bfloat16: models compute in bf16 anyway, and halving the
+        resident feature bytes halves the hot loop's HBM reads; pass
+        ``feature_dtype=None`` to keep the host dtype, e.g. for f32
+        oracle-parity runs). Integer features (token ids) are unaffected.
         """
         sh = plan.client_sharding()
         put = lambda a: global_put(np.asarray(a), sh)
+        x = np.asarray(self.x)
+        if feature_dtype is not None and np.issubdtype(x.dtype, np.floating):
+            x = x.astype(feature_dtype)
         return ClientDataset(
-            x=put(self.x),
+            x=put(x),
             y=put(self.y),
             num_samples=put(np.asarray(self.num_samples, np.int32)),
             client_uid=put(np.asarray(self.client_uid, np.int32)),

@@ -93,6 +93,27 @@ class FedCoreConfig:
     # Storage dtype for Ditto per-client personal params; None = same as the
     # global params. jnp.bfloat16 halves resident HBM at 10k-client scale.
     personal_dtype: Any = None
+    # Minibatch realization. "gather": draw indices and gather rows (the
+    # textbook form). "multiplicity": draw the same indices but realize the
+    # batch as per-sample multiplicity weights over the client's full local
+    # set — sum_b grad(x[i_b]) == sum_i m_i grad(x_i), so the gradient and
+    # loss are EXACTLY those of the gathered minibatch (same RNG draw), but
+    # the dynamic gather disappears from the hot loop and the fwd/bwd runs
+    # over n_local samples instead of batch_size. The two modes are
+    # mathematically identical for the same index draw (not bitwise: the
+    # reductions accumulate in different orders). "auto" picks multiplicity
+    # when n_local <= 2 * batch_size (profiling: the gather alone cost
+    # ~4.6ms per 128-client block-step on v5e).
+    sample_mode: str = "auto"
+
+    def use_multiplicity(self, n_local: int) -> bool:
+        if self.sample_mode == "multiplicity":
+            return True
+        if self.sample_mode == "gather":
+            return False
+        if self.sample_mode != "auto":
+            raise ValueError(f"unknown sample_mode {self.sample_mode!r}")
+        return n_local <= 2 * self.batch_size
 
 
 def _to_varying(tree, axis: str):
@@ -156,31 +177,74 @@ class FedCore:
 
     # ------------------------------------------------------- local training
     def _masked_sgd(self, params0, opt_state0, x, y, num_samples, steps_eff,
-                    key, loss_fn, grad_transform=None, varying_init=False):
+                    key, persample_loss_fn, penalty_fn=None,
+                    grad_transform=None, varying_init=False):
         """Masked local-SGD loop shared by the global and Ditto branches:
         step ``i`` samples a minibatch from the valid prefix, applies the
         local optimizer, and is a no-op when ``i >= steps_eff``. Returns
         (final_params, mean_loss) with NaN loss for zero-step clients ("no
         work performed" must not read as success downstream — finiteness is
         the success signal replacing subprocess exit codes).
+
+        ``persample_loss_fn(params, x, y) -> [n]`` unreduced losses;
+        ``penalty_fn(params) -> scalar`` optional regularizer (FedProx).
+        The minibatch is realized either by gathering rows or — for small
+        local sets — as multiplicity weights over the full set (see
+        ``FedCoreConfig.sample_mode``); both produce mathematically
+        identical gradients for the same index draw (up to float reduction
+        order).
         """
         cfg = self.config
         alg = self.algorithm
         n = jnp.maximum(num_samples, 1)
+        n_local = x.shape[0]
+        use_mult = cfg.use_multiplicity(n_local)
+        # SGD without momentum has an empty optimizer state; then masking is
+        # cheaper as update-scaling (one fused multiply) than as a
+        # double-buffered tree_where over params AND state.
+        stateless_opt = not jax.tree.leaves(opt_state0)
 
         def step(carry, i):
             params, opt_state = carry
             k = jax.random.fold_in(key, i)
             idx = jax.random.randint(k, (cfg.batch_size,), 0, n)
-            xb = jnp.take(x, idx, axis=0)
-            yb = jnp.take(y, idx, axis=0)
-            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+
+            if use_mult:
+                sw = (
+                    jnp.zeros((n_local,), jnp.float32).at[idx].add(1.0)
+                    / cfg.batch_size
+                )
+
+                def loss_fn(p):
+                    loss = (sw * persample_loss_fn(p, x, y)).sum()
+                    return loss + (penalty_fn(p) if penalty_fn else 0.0)
+            else:
+
+                def loss_fn(p):
+                    xb = jnp.take(x, idx, axis=0)
+                    yb = jnp.take(y, idx, axis=0)
+                    loss = persample_loss_fn(p, xb, yb).mean()
+                    return loss + (penalty_fn(p) if penalty_fn else 0.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
             if grad_transform is not None:
                 grads = grad_transform(grads, params)
             updates, new_opt = alg.local_optimizer.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
             active = i < steps_eff
-            carry = _tree_where(active, (new_params, new_opt), (params, opt_state))
+            if stateless_opt:
+                # where, not multiply-by-gate: 0 * non-finite = NaN would let
+                # an inactive step corrupt params that must stay frozen
+                # (e.g. a churned-out Ditto client whose data still produces
+                # overflowing grads under the shared vmap).
+                updates = jax.tree.map(
+                    lambda u: jnp.where(active, u, jnp.zeros_like(u)), updates
+                )
+                carry = (optax.apply_updates(params, updates), opt_state)
+            else:
+                new_params = optax.apply_updates(params, updates)
+                carry = _tree_where(
+                    active, (new_params, new_opt), (params, opt_state)
+                )
             return carry, jnp.where(active, loss, 0.0)
 
         init = (params0, opt_state0)
@@ -212,16 +276,18 @@ class FedCore:
         # an explicit cap, and metrics divide by the steps actually run.
         steps_eff = jnp.minimum(num_steps, self.config.max_local_steps)
 
-        def loss_fn(p, xb, yb):
+        def persample(p, xb, yb):
             logits = self.apply_fn(p, xb)
-            loss = optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
-            if alg.prox_mu:
-                loss = loss + 0.5 * alg.prox_mu * _tree_l2_sq(p, global_params)
-            return loss
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+
+        penalty = None
+        if alg.prox_mu:
+            penalty = lambda p: 0.5 * alg.prox_mu * _tree_l2_sq(p, global_params)
 
         params, mean_loss = self._masked_sgd(
             global_params, alg.local_optimizer.init(global_params),
-            x, y, num_samples, steps_eff, key, loss_fn, varying_init=True,
+            x, y, num_samples, steps_eff, key, persample, penalty_fn=penalty,
+            varying_init=True,
         )
         delta = jax.tree.map(jnp.subtract, params, global_params)
         return delta, mean_loss
@@ -245,9 +311,9 @@ class FedCore:
             active, jnp.minimum(num_steps, self.config.max_local_steps), 0
         )
 
-        def loss_fn(v, xb, yb):
+        def persample(v, xb, yb):
             logits = self.apply_fn(v, xb)
-            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yb)
 
         def ditto_pull(grads, v):
             return jax.tree.map(
@@ -259,7 +325,7 @@ class FedCore:
         # already device-varying — no pcast (varying_init=False).
         v, mean_loss = self._masked_sgd(
             v0, alg.local_optimizer.init(v0), x, y, num_samples, steps_eff,
-            key, loss_fn, grad_transform=ditto_pull,
+            key, persample, grad_transform=ditto_pull,
         )
         return jax.tree.map(lambda t, orig: t.astype(orig.dtype), v, vparams), mean_loss
 

@@ -149,6 +149,7 @@ def build_runner_from_taskconfig(
         max_local_steps=int(fed_cfg.get("max_local_steps", 10)),
         block_clients=int(fed_cfg.get("block_clients", 64)),
         personal_dtype=personal_dtype,
+        sample_mode=fed_cfg.get("sample_mode", "auto"),
     )
     algorithm = algorithm_from_config(algo_cfg.pop("name", "fedavg"), **algo_cfg)
     input_shape = tuple(model_cfg.get("input_shape", [])) or None
@@ -165,7 +166,20 @@ def build_runner_from_taskconfig(
 
     spec = get_model(model_cfg.get("name", "mlp2"))
     syn = data_cfg.get("synthetic", {})
-    num_classes = int(syn.get("num_classes", spec.num_classes))
+    # The model's configured head size is the source of truth for how many
+    # classes it can emit (mirrors the vocab-size handling below); the
+    # synthetic generator may use fewer.
+    model_classes = int(
+        (model_cfg.get("overrides") or {}).get(
+            "num_classes", spec.defaults.get("num_classes", spec.num_classes)
+        )
+    )
+    num_classes = int(syn.get("num_classes", model_classes))
+    if num_classes > model_classes:
+        raise ValueError(
+            f"data.synthetic.num_classes={num_classes} exceeds the model's "
+            f"head size {model_classes}; labels would fall outside the logits"
+        )
     if input_shape is None:
         input_shape = spec.example_input_shape
     # Token models (int input dtype) get the text population; everything else
@@ -201,7 +215,36 @@ def build_runner_from_taskconfig(
         if not dynamic:
             dynamic = [0] * len(nums)
         num_clients = sum(nums)
-        if is_text:
+        eval_data = None
+        if td.dataPath:
+            # Real dataset: honor dataPath + dataTransferType (reference
+            # download_data_files, utils_run_task.py:174-325). The archive's
+            # test split (or a held-out tail) is the central eval set.
+            from olearning_sim_tpu.data import load_population
+
+            real_cfg = data_cfg.get("real", {})
+            text_kwargs = (
+                {"vocab_size": vocab_size, "seq_len": int(input_shape[0])}
+                if is_text else {}
+            )
+            ds, eval_data, data_classes = load_population(
+                td.dataPath,
+                num_clients=num_clients,
+                n_local=int(real_cfg.get("n_local", syn.get("n_local", 20))),
+                scheme=real_cfg.get("scheme", "dirichlet"),
+                alpha=float(real_cfg.get("alpha", syn.get("dirichlet_alpha") or 0.5)),
+                seed=int(syn.get("seed", 0)),
+                transfer_type=td.dataTransferType,
+                storage_settings=params.get("storage"),
+                eval_n=data_cfg.get("eval_n"),
+                **text_kwargs,
+            )
+            if data_classes > model_classes:
+                raise ValueError(
+                    f"dataset at {td.dataPath!r} has {data_classes} classes "
+                    f"but the model's head emits only {model_classes}"
+                )
+        elif is_text:
             ds = make_synthetic_text_dataset(
                 seed=int(syn.get("seed", 0)),
                 num_clients=num_clients,
@@ -227,8 +270,7 @@ def build_runner_from_taskconfig(
         for ci, n in enumerate(nums):
             cls[start : start + n] = ci
             start += n
-        eval_data = None
-        if data_cfg.get("eval_n"):
+        if eval_data is None and not td.dataPath and data_cfg.get("eval_n"):
             if is_text:
                 eval_data = make_central_text_eval_set(
                     int(syn.get("seed", 0)), int(data_cfg["eval_n"]),

@@ -22,7 +22,7 @@ CONFIGS = sorted(f for f in os.listdir(CONFIG_DIR) if f.endswith(".json"))
 
 SMALL_MODEL_OVERRIDES = {
     "mlp2": {"hidden": [16]},
-    "cnn4": {"features": [8, 8], "dense": 16},
+    "cnn4": {"features": [8, 8, 16]},
     "resnet18": {"stage_features": [8, 16], "blocks_per_stage": [1, 1]},
     "distilbert": {"width": 32, "depth": 1, "heads": 2, "mlp_dim": 64,
                    "vocab_size": 128, "max_len": 16},
