@@ -1,9 +1,10 @@
-"""Headline benchmark: FL rounds/sec simulating 10k clients, 4-layer CNN on
-CIFAR-10-shaped data (BASELINE.md: >=500 rounds/min over 10k clients on a
-v4-32).
+"""Benchmarks of record (BASELINE.md).
+
+Headline: FL rounds/sec simulating 10k clients, 4-layer CNN on CIFAR-10
+shapes (BASELINE: >=500 rounds/min over 10k clients on a v4-32).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
 ``vs_baseline`` is measured per-chip rounds/sec divided by the reference
 target's per-chip rounds/sec. Per-chip math, stated explicitly: a v4-32 is
@@ -11,6 +12,12 @@ target's per-chip rounds/sec. Per-chip math, stated explicitly: a v4-32 is
 500/60/16 = 0.521 rounds/sec per chip; >1.0 means beating the v4-32 target
 chip-for-chip (ignoring that v4 has ~1.4x the bf16 peak of the v5e this
 runs on — the conservative direction).
+
+``detail.suite`` covers all five BASELINE task families at 1k clients
+(and the headline at 10k): rounds/sec, device-rounds/sec, and per-client
+local-step latency percentiles (the BASELINE metrics of record). The full
+suite also lands in ``BENCH_suite.json``. Set ``OLS_BENCH_FAST=1`` to run
+the headline only.
 """
 
 import json
@@ -19,12 +26,19 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine import (
+    build_fedcore,
+    ditto,
+    fedadam,
+    fedavg,
+    fedprox,
+    make_synthetic_dataset,
+)
+from olearning_sim_tpu.engine.client_data import make_synthetic_text_dataset
 from olearning_sim_tpu.engine.fedcore import FedCoreConfig
 from olearning_sim_tpu.parallel.mesh import make_mesh_plan
 
@@ -32,61 +46,152 @@ V4_32_CHIPS = 16  # 32 TensorCores / 2 cores per chip
 BASELINE_ROUNDS_PER_SEC_PER_CHIP = 500.0 / 60.0 / V4_32_CHIPS
 
 
+def run_family(plan, *, name, model, algorithm, num_clients, n_local,
+               input_shape=None, text=False, num_classes=10, batch=32,
+               local_steps=10, block=256, timed_rounds=3,
+               model_overrides=None, vocab_size=None, seq_len=None):
+    """One benchmark family: build, warm, time. Returns the record dict."""
+    cfg = FedCoreConfig(batch_size=batch, max_local_steps=local_steps,
+                        block_clients=block)
+    core = build_fedcore(model, algorithm, plan, cfg,
+                         model_overrides=model_overrides,
+                         input_shape=input_shape)
+    if text:
+        ds = make_synthetic_text_dataset(
+            seed=0, num_clients=num_clients, n_local=n_local,
+            seq_len=seq_len, num_classes=num_classes, vocab_size=vocab_size,
+            dirichlet_alpha=0.5,
+        )
+    else:
+        ds = make_synthetic_dataset(
+            seed=0, num_clients=num_clients, n_local=n_local,
+            input_shape=input_shape, num_classes=num_classes,
+            dirichlet_alpha=0.5,
+        )
+    ds = ds.pad_for(plan, block).place(plan)
+    state = core.init_state(jax.random.key(0))
+    personal = (core.init_personal(state, ds.num_clients)
+                if core.algorithm.personalized else None)
+
+    def step():
+        nonlocal state, personal
+        if personal is not None:
+            out = core.round_step(state, ds, personal=personal)
+            state, metrics, personal = out
+        else:
+            state, metrics = core.round_step(state, ds)
+        return metrics
+
+    # Warmup (compile + 1 round); float() forces a real host sync on
+    # relay/tunnel platforms where block_until_ready returns early.
+    t0 = time.perf_counter()
+    metrics = step()
+    float(metrics.mean_loss)
+    compile_s = time.perf_counter() - t0
+
+    times = []
+    for _ in range(timed_rounds):
+        t0 = time.perf_counter()
+        metrics = step()
+        loss = float(metrics.mean_loss)
+        times.append(time.perf_counter() - t0)
+    times = np.asarray(times)
+    rps = 1.0 / times.mean()
+    step_lat = times / (num_clients * local_steps)  # per client local step
+    return {
+        "family": name,
+        "clients": num_clients,
+        "local_steps": local_steps,
+        "rounds_per_sec": round(float(rps), 4),
+        "device_rounds_per_sec": round(float(rps * num_clients), 1),
+        "round_time_sec": round(float(times.mean()), 4),
+        "client_step_latency_us_p50": round(float(np.percentile(step_lat, 50) * 1e6), 3),
+        "client_step_latency_us_p90": round(float(np.percentile(step_lat, 90) * 1e6), 3),
+        "compile_sec": round(compile_s, 1),
+        "mean_loss": loss,
+    }
+
+
 def main():
     on_cpu = jax.default_backend() == "cpu"
-    num_clients = 512 if on_cpu else 10_000
-    n_local = 8 if on_cpu else 20
-    block = 32 if on_cpu else 256
-    local_steps = 2 if on_cpu else 10
-    batch = 8 if on_cpu else 32
-    timed_rounds = 2 if on_cpu else 3
-
+    fast = on_cpu or os.environ.get("OLS_BENCH_FAST") == "1"
     plan = make_mesh_plan()
-    cfg = FedCoreConfig(batch_size=batch, max_local_steps=local_steps, block_clients=block)
-    core = build_fedcore("cnn4", fedavg(0.05), plan, cfg)
 
-    ds = make_synthetic_dataset(
-        seed=0,
-        num_clients=num_clients,
-        n_local=n_local,
-        input_shape=(32, 32, 3),
-        num_classes=10,
-        dirichlet_alpha=0.5,
-    ).pad_for(plan, block).place(plan)
+    shrink = dict(num_clients=512, n_local=8, batch=8, local_steps=2,
+                  block=32, timed_rounds=2) if on_cpu else {}
 
-    state = core.init_state(jax.random.key(0))
+    # ------------------------------------------------------------ headline
+    headline = run_family(
+        plan, name="fedavg_cifar10_cnn4_10k", model="cnn4",
+        algorithm=fedavg(0.05),
+        **{**dict(num_clients=10_000, n_local=20, input_shape=(32, 32, 3),
+                  num_classes=10, batch=32, local_steps=10, block=256,
+                  timed_rounds=3), **shrink},
+    )
 
-    # Warmup: compile + one round. float() forces a host transfer — a real
-    # synchronization barrier even on relay/tunnel platforms where
-    # block_until_ready returns early.
-    state, metrics = core.round_step(state, ds)
-    float(metrics.mean_loss)
-
-    t0 = time.perf_counter()
-    for _ in range(timed_rounds):
-        state, metrics = core.round_step(state, ds)
-    last_loss = float(metrics.mean_loss)
-    dt = time.perf_counter() - t0
-
-    rounds_per_sec = timed_rounds / dt
+    # The headline line goes out BEFORE the breadth suite runs: a suite
+    # failure (OOM on a big family, tunnel loss) must not cost the already-
+    # measured metric of record.
     n_chips = len(jax.devices())
-    per_chip = rounds_per_sec / n_chips
+    per_chip = headline["rounds_per_sec"] / n_chips
     result = {
-        "metric": f"FL rounds/sec, {num_clients} clients x {local_steps} local steps, cnn4/CIFAR-10 shapes",
-        "value": round(rounds_per_sec, 4),
+        "metric": (
+            f"FL rounds/sec, {headline['clients']} clients x "
+            f"{headline['local_steps']} local steps, cnn4/CIFAR-10 shapes"
+        ),
+        "value": headline["rounds_per_sec"],
         "unit": "rounds/sec",
         "vs_baseline": round(per_chip / BASELINE_ROUNDS_PER_SEC_PER_CHIP, 4),
         "detail": {
-            "device_rounds_per_sec": round(num_clients * rounds_per_sec, 1),
             "chips": n_chips,
             "baseline_chips_v4_32": V4_32_CHIPS,
-            "baseline_rounds_per_sec_per_chip": round(BASELINE_ROUNDS_PER_SEC_PER_CHIP, 4),
+            "baseline_rounds_per_sec_per_chip": round(
+                BASELINE_ROUNDS_PER_SEC_PER_CHIP, 4
+            ),
             "backend": jax.default_backend(),
-            "round_time_sec": round(dt / timed_rounds, 4),
-            "mean_loss": last_loss,
+            "headline": headline,
+            "suite_file": None if fast else "BENCH_suite.json",
         },
     }
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
+
+    if fast:
+        return
+
+    suite = [headline]
+    families = [
+        dict(name="fedavg_mnist_mlp_1k", model="mlp2",
+             algorithm=fedavg(0.05), num_clients=1000, n_local=20,
+             input_shape=(28, 28, 1), block=256, batch=32, local_steps=10,
+             timed_rounds=2),
+        dict(name="fedavg_cifar10_cnn4_1k", model="cnn4",
+             algorithm=fedavg(0.05), num_clients=1000, n_local=20,
+             input_shape=(32, 32, 3), block=256, batch=32, local_steps=10,
+             timed_rounds=2),
+        dict(name="fedprox_femnist_resnet18_1k", model="resnet18",
+             algorithm=fedprox(0.05, mu=0.01), num_clients=1000, n_local=16,
+             input_shape=(28, 28, 1), num_classes=62, block=32,
+             batch=16, local_steps=5, timed_rounds=2),
+        dict(name="fedadam_sent140_distilbert_1k", model="distilbert",
+             algorithm=fedadam(0.05), num_clients=1000, n_local=8, text=True,
+             seq_len=64, vocab_size=30522, num_classes=2,
+             input_shape=(64,), block=8, batch=16, local_steps=5,
+             timed_rounds=2),
+        dict(name="ditto_cifar100_vit_tiny_1k", model="vit_tiny",
+             algorithm=ditto(0.05, lam=0.1), num_clients=1000, n_local=16,
+             input_shape=(32, 32, 3), num_classes=100, block=16,
+             batch=16, local_steps=5, timed_rounds=2),
+    ]
+    suite_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_suite.json"
+    )
+    for fam in families:
+        try:
+            suite.append(run_family(plan, **fam))
+        except Exception as e:  # noqa: BLE001 — one family must not kill the rest
+            suite.append({"family": fam["name"], "error": str(e)[:500]})
+        with open(suite_path, "w") as f:
+            json.dump(suite, f, indent=1)
 
 
 if __name__ == "__main__":

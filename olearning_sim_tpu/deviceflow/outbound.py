@@ -1,0 +1,133 @@
+"""Network outbound producers for dispatched deviceflow batches.
+
+Reference: the gradient house forwards each dispatched batch to the task's
+*outbound service* — a Pulsar producer or a WebSocket producer that wraps
+every payload as ``{"payload": base64(...)}`` (the Pulsar WebSocket-producer
+wire format, ``ols_core/deviceflow/non_grpc/message_producer.py:42-78``) —
+so an external aggregator receives the behavior-shaped stream. The rebuild
+keeps the WebSocket format byte-compatible and replaces the Pulsar option
+with a gRPC ``OutboundSink`` service (``proto/services.proto``): brokerless,
+and the control plane already speaks gRPC.
+
+A producer is a callable ``producer(batch: List[Any]) -> None`` (the
+contract ``Dispatcher`` expects); ``close()`` is optional.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _encode(msg: Any) -> str:
+    return msg if isinstance(msg, str) else json.dumps(msg, default=str)
+
+
+class WebsocketProducer:
+    """Sends each dispatched message as ``{"payload": base64(json)}`` text
+    frames — the reference WebsocketProducer's exact format
+    (``message_producer.py:59-78``). Lazily connects; one reconnect attempt
+    per send so a bounced aggregator doesn't drop the whole flow."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url
+        self.timeout = timeout
+        self._ws = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        import websocket  # websocket-client, lazy so tests can stub
+
+        self._ws = websocket.create_connection(self.url, timeout=self.timeout)
+
+    def _send(self, frame: str) -> None:
+        if self._ws is None:
+            self._connect()
+        try:
+            self._ws.send(frame)
+        except Exception:
+            self.close()
+            self._connect()
+            self._ws.send(frame)
+
+    def __call__(self, batch: List[Any]) -> None:
+        with self._lock:
+            for msg in batch:
+                payload = base64.b64encode(_encode(msg).encode()).decode()
+                self._send(json.dumps({"payload": payload}))
+
+    def close(self) -> None:
+        ws, self._ws = self._ws, None
+        if ws is not None:
+            try:
+                ws.close()
+            except Exception:
+                pass
+
+
+class GrpcOutboundProducer:
+    """Publishes dispatched batches to an external ``OutboundSink`` gRPC
+    service (one RPC per batch, preserving the dispatcher's batching)."""
+
+    def __init__(self, target: str, flow_id: str = "", timeout: float = 10.0):
+        import grpc
+
+        from olearning_sim_tpu.proto import services_pb2 as spb
+
+        self._spb = spb
+        self.flow_id = flow_id
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(target)
+        self._publish = self._channel.unary_unary(
+            "/olearning_sim_tpu.services.OutboundSink/PublishBatch",
+            request_serializer=spb.OutboundBatch.SerializeToString,
+            response_deserializer=spb.Ack.FromString,
+        )
+
+    def __call__(self, batch: List[Any]) -> None:
+        req = self._spb.OutboundBatch(
+            flow_id=self.flow_id, messages=[_encode(m) for m in batch]
+        )
+        ack = self._publish(req, timeout=self.timeout)
+        if not ack.is_success:
+            raise IOError(f"OutboundSink rejected batch: {ack.message}")
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def make_outbound_factory(
+    default_cfg: Optional[Dict[str, Any]] = None,
+    fallback: Optional[Callable[[str, Dict[str, Any]], Callable]] = None,
+):
+    """Factory for ``DeviceFlowService(outbound_factory=...)``.
+
+    Per-flow config (the ``outbound_service`` dict a task's NotifyStart
+    carries, falling back to ``default_cfg``)::
+
+        {"type": "websocket", "url": "ws://aggregator:8765/ws"}
+        {"type": "grpc", "target": "aggregator:50070"}
+        {"type": "memory"}   # or anything else -> ``fallback``
+
+    ``fallback`` handles unrecognized/absent configs (the service's
+    in-memory collector by default).
+    """
+
+    def factory(flow_id: str, cfg: Dict[str, Any]):
+        eff = dict(default_cfg or {})
+        eff.update(cfg or {})
+        kind = str(eff.get("type") or eff.get("kind") or "").lower()
+        if kind in ("websocket", "ws"):
+            return WebsocketProducer(eff["url"], timeout=float(eff.get("timeout", 10.0)))
+        if kind == "grpc":
+            return GrpcOutboundProducer(
+                eff.get("target") or eff["url"], flow_id,
+                timeout=float(eff.get("timeout", 10.0)),
+            )
+        if fallback is not None:
+            return fallback(flow_id, eff)
+        raise ValueError(f"unknown outbound service type {kind!r} for flow {flow_id}")
+
+    return factory

@@ -73,10 +73,19 @@ class DeviceFlowService:
         self._sorted_count = 0
 
     def _default_outbound(self, flow_id: str, cfg: Dict[str, Any]):
-        def producer(batch: List[Any]):
-            self.delivered.setdefault(flow_id, []).extend(batch)
+        """Dispatch on the flow's outbound_service config: network types
+        (websocket / grpc — deviceflow/outbound.py, the reference's
+        Pulsar/WS producers message_producer.py:42-78) get a real producer;
+        anything else collects in-memory for in-process consumers."""
+        from olearning_sim_tpu.deviceflow.outbound import make_outbound_factory
 
-        return producer
+        def in_memory(fid, _cfg):
+            def producer(batch: List[Any]):
+                self.delivered.setdefault(fid, []).extend(batch)
+
+            return producer
+
+        return make_outbound_factory(fallback=in_memory)(flow_id, cfg)
 
     # ----------------------------------------------------------------- RPCs
     def register_task(self, task_id: str, total_compute_resources: List[str]) -> bool:
@@ -208,13 +217,27 @@ class DeviceFlowService:
                 for flow_id, params in list(self.flow.items()):
                     if not params.get("to_dispatch") or flow_id in self._dispatchers:
                         continue
+                    if flow_id in self._dispatch_failed:
+                        continue
+                    try:
+                        producer = self._outbound_factory(
+                            flow_id, params.get("outbound_service", {})
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        # A malformed outbound config fails THIS flow, not
+                        # the dispatch loop serving every other task.
+                        self._dispatch_failed.add(flow_id)
+                        self.logger.error(
+                            task_id=params.get("task_id", ""),
+                            system_name="DeviceFlow", module_name="dispatch",
+                            message=f"outbound producer for {flow_id} failed: {e}",
+                        )
+                        continue
                     disp = Dispatcher(
                         flow_id=flow_id,
                         strategy=params["strategy"],
                         shelf_room=self.shelf_room,
-                        producer=self._outbound_factory(
-                            flow_id, params.get("outbound_service", {})
-                        ),
+                        producer=producer,
                         clock=self.clock,
                         # crc32 keeps per-flow streams stable across processes
                         # (hash() is salted by PYTHONHASHSEED).

@@ -148,32 +148,64 @@ class FedCore:
         algorithm: Algorithm,
         plan: MeshPlan,
         config: FedCoreConfig = FedCoreConfig(),
+        param_specs: Any = None,
     ):
+        """``param_specs`` — optional PartitionSpec pytree (same treedef as
+        the params) sharding model tensors over the mesh ``mp`` axis
+        (:func:`olearning_sim_tpu.parallel.tp.tp_param_specs`). The round
+        program is manual over ``dp`` and *auto* over ``mp``, so GSPMD
+        inserts the tensor-parallel collectives from these annotations."""
         self.apply_fn = apply_fn
         self.init_params_fn = init_params_fn
         self.algorithm = algorithm
         self.plan = plan
         self.config = config
+        self.param_specs = param_specs
         self._round_step = self._build_round_step()
         self._evaluate = self._build_evaluate()
         self._evaluate_personal = None  # built on first use
 
+    def _param_shardings(self):
+        if self.param_specs is None:
+            return None
+        mesh = self.plan.mesh
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self.param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
     # ------------------------------------------------------------------ init
     def init_state(self, rng: jax.Array) -> ServerState:
-        def make(rng):
-            pk, bk = jax.random.split(rng)
-            params = self.init_params_fn(pk)
-            opt_state = self.algorithm.server_optimizer.init(params)
-            return ServerState(
-                params=params,
-                opt_state=opt_state,
-                round_idx=jnp.int32(0),
-                base_key=bk,
-            )
-
-        # jit with out_shardings (not device_put) so replication also works on
+        # jit with out_shardings (not device_put) so placement also works on
         # multi-host meshes, where the sharding spans non-addressable devices.
-        return jax.jit(make, out_shardings=self.plan.replicated())(rng)
+        rep = self.plan.replicated()
+        shardings = self._param_shardings()
+        if shardings is None:
+
+            def make(rng):
+                pk, bk = jax.random.split(rng)
+                params = self.init_params_fn(pk)
+                opt_state = self.algorithm.server_optimizer.init(params)
+                return ServerState(
+                    params=params,
+                    opt_state=opt_state,
+                    round_idx=jnp.int32(0),
+                    base_key=bk,
+                )
+
+            return jax.jit(make, out_shardings=rep)(rng)
+        # Tensor-parallel: params placed per spec; the optimizer state is
+        # initialized in a follow-up jit with no out constraint, so GSPMD
+        # shards moments/momenta exactly like the params they track.
+        pk, bk = jax.jit(jax.random.split, out_shardings=rep)(rng)
+        params = jax.jit(self.init_params_fn, out_shardings=shardings)(pk)
+        opt_state = jax.jit(self.algorithm.server_optimizer.init)(params)
+        return ServerState(
+            params=params,
+            opt_state=opt_state,
+            round_idx=jax.jit(lambda: jnp.int32(0), out_shardings=rep)(),
+            base_key=bk,
+        )
 
     # ------------------------------------------------------- local training
     def _masked_sgd(self, params0, opt_state0, x, y, num_samples, steps_eff,
@@ -330,10 +362,11 @@ class FedCore:
         return jax.tree.map(lambda t, orig: t.astype(orig.dtype), v, vparams), mean_loss
 
     # ----------------------------------------------------------- round step
-    # NOTE on the mp axis: model params are currently replicated, so mp > 1
-    # duplicates client work rather than splitting tensors. mp becomes a real
-    # tensor-parallel axis with the transformer families; keep mp=1 for
-    # throughput benchmarking until then.
+    # The mp axis is AUTO (not manual) in the shard_map below: model tensors
+    # annotated by param_specs stay sharded over mp through the whole round
+    # program and GSPMD inserts the tensor-parallel collectives. Models
+    # without specs (all-P() trees) are replicated over mp — correct but
+    # redundant; the transformer families shard (parallel/tp.py).
     def _build_round_step(self):
         plan = self.plan
         cfg = self.config
@@ -443,11 +476,15 @@ class FedCore:
 
         def make_shard_fn(vp_tree):
             vp_spec = jax.tree.map(lambda _: cl, vp_tree)
+            # Manual over dp only; mp is an AUTO axis — specs here describe
+            # the dp placement, while the mp sharding of model tensors rides
+            # in from param_specs and GSPMD inserts the TP collectives.
             return jax.shard_map(
                 shard_body,
                 mesh=mesh,
                 in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl, vp_spec),
                 out_specs=(rep, rep, rep, metrics_specs, vp_spec),
+                axis_names=frozenset({"dp"}),
             )
 
         if personalized:
@@ -495,17 +532,27 @@ class FedCore:
     def init_personal(self, state: ServerState, num_clients: int) -> PersonalState:
         """Materialize Ditto personal params for ``num_clients`` (padded)
         clients: every client starts at the current global model, stored
-        sharded over ``dp`` in ``config.personal_dtype``."""
+        sharded over ``dp`` (and, for tensor-parallel leaves, additionally
+        over ``mp``) in ``config.personal_dtype``."""
         dt = self.config.personal_dtype
-        sh = self.plan.client_sharding()
+        mesh = self.plan.mesh
 
         def tile(p):
             target = p.astype(dt) if dt is not None else p
             return jnp.broadcast_to(target[None], (num_clients,) + p.shape)
 
+        if self.param_specs is None:
+            out = jax.tree.map(
+                lambda _: NamedSharding(mesh, P("dp")), state.params
+            )
+        else:
+            out = jax.tree.map(
+                lambda _, s: NamedSharding(mesh, P("dp", *s)),
+                state.params, self.param_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
         tiled = jax.jit(
-            lambda params: jax.tree.map(tile, params),
-            out_shardings=jax.tree.map(lambda _: sh, state.params),
+            lambda params: jax.tree.map(tile, params), out_shardings=out
         )(state.params)
         return PersonalState(params=tiled)
 
@@ -632,6 +679,7 @@ class FedCore:
                     mesh=self.plan.mesh,
                     in_specs=(vp_spec, cl, cl, cl, cl),
                     out_specs=(rep, rep),
+                    axis_names=frozenset({"dp"}),
                 )
             )
 
@@ -684,4 +732,16 @@ def build_fedcore(
         dummy = jnp.zeros((1,) + in_shape, spec.input_dtype)
         return model.init(rng, dummy)["params"]
 
-    return FedCore(apply_fn, init_params_fn, algorithm, plan, config)
+    param_specs = None
+    if plan.mp > 1:
+        # mp > 1 means the caller asked for tensor parallelism: derive the
+        # Megatron-layout specs from the param shapes (transformer-block
+        # tensors shard; everything else — and any model without such
+        # blocks — stays replicated).
+        from olearning_sim_tpu.parallel.tp import tp_param_specs
+
+        shapes = jax.eval_shape(init_params_fn, jax.random.key(0))
+        param_specs = tp_param_specs(shapes, plan.mp)
+
+    return FedCore(apply_fn, init_params_fn, algorithm, plan, config,
+                   param_specs=param_specs)

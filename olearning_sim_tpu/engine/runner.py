@@ -62,6 +62,10 @@ class OperatorSpec:
     kind: str = "train"
     use_deviceflow: bool = False
     deviceflow_strategy: str = ""
+    # OperationBehaviorController.outboundService (taskservice.proto:86-88):
+    # JSON config for where dispatched batches go, e.g.
+    # {"type": "websocket", "url": "ws://..."} (deviceflow/outbound.py).
+    outbound_service: str = ""
     inputs: List[str] = dataclasses.field(default_factory=list)
     custom_fn: Optional[Callable[..., Dict[str, Any]]] = None
 
@@ -103,7 +107,15 @@ class SimulationRunner:
         checkpointer: Optional[Any] = None,
         checkpoint_every: int = 1,
         perf: Optional[Any] = None,
+        model_io: Optional[Any] = None,
+        warm_start_path: Optional[str] = None,
     ):
+        """``model_io`` — a :class:`ModelUpdateExporter` realizing the
+        reference's model-update-style convention (round r's global model
+        exported to storage as ``{task_id}_{r}_result_model.*`` and
+        re-ingestable; ``utils_run_task.py:327-397``). ``warm_start_path`` —
+        round-0 initial model fetched through ``model_io``'s repo
+        (``Model.modelPath`` with ``useModel``)."""
         self.task_id = task_id
         self.core = core
         self.populations = populations
@@ -118,9 +130,15 @@ class SimulationRunner:
         self.checkpointer = checkpointer  # RoundCheckpointer (optional)
         self.checkpoint_every = max(1, int(checkpoint_every))
         self.perf = perf  # PerformanceManager (optional)
+        self.model_io = model_io
+        self.warm_start_path = warm_start_path
+        if warm_start_path and model_io is None:
+            raise ValueError("warm_start_path needs model_io (a repo to fetch it from)")
+        self._model_io_export_dead = False
         self.stopped = False
         self.states: Dict[str, Any] = {}
         self._custom_arity: Dict[int, bool] = {}
+        self._round_outputs: Dict[str, Any] = {}
         # Ditto per-client personal state per population (personalized algos).
         self.personal_states: Dict[str, Any] = {}
         self.history: List[Dict[str, Any]] = []
@@ -186,9 +204,19 @@ class SimulationRunner:
         if self.deviceflow is None or not operator.use_deviceflow:
             return None
         routing_key = f"{self.task_id}_{operator.name}_{round_idx}"
+        outbound = None
+        if operator.outbound_service:
+            try:
+                outbound = json.loads(operator.outbound_service)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"operator {operator.name}: outbound_service is not "
+                    f"valid JSON: {e}"
+                ) from e
         ok, msg = self.deviceflow.notify_start(
             self.task_id, routing_key, "logical_simulation",
             operator.deviceflow_strategy or "{}",
+            outbound_service=outbound,
         )
         if not ok:
             raise RuntimeError(f"deviceflow NotifyStart failed for {routing_key}: {msg}")
@@ -271,6 +299,97 @@ class SimulationRunner:
         return rec
 
     # ------------------------------------------------------------- checkpoint
+    # --------------------------------------------------- model file interop
+    def _host_params(self, params):
+        """Fetch a param tree to host numpy, multi-host/TP-safe: leaves that
+        span non-addressable devices (mp-sharded tensors on a pod) are
+        replicated first — device_get on them would raise."""
+        if all(
+            getattr(leaf, "is_fully_addressable", True)
+            for leaf in jax.tree.leaves(params)
+        ):
+            return jax.device_get(params)
+        rep = self.core.plan.replicated()
+        replicated = jax.jit(
+            lambda p: p, out_shardings=jax.tree.map(lambda _: rep, params)
+        )(params)
+        return jax.device_get(replicated)
+
+    def _place_params(self, host_params):
+        """Host param tree -> placed per the core's param shardings (mp-
+        sharded leaves land sharded; everything else replicated)."""
+        sh = self.core._param_shardings()
+        if sh is None:
+            rep = self.core.plan.replicated()
+            sh = jax.tree.map(lambda _: rep, host_params)
+        return jax.tree.map(
+            lambda leaf, s: global_put(np.asarray(leaf), s), host_params, sh
+        )
+
+    def _set_params(self, host_params, next_round: Optional[int] = None) -> None:
+        """Install ingested params into every population's state. When the
+        ingested model represents completed training through round
+        ``next_round - 1``, the device round counter moves too — it feeds
+        every client's RNG stream (fold_in(key, round)), so leaving it at 0
+        would make a resumed run replay round-0 minibatches."""
+        placed = self._place_params(host_params)
+        for name, state in list(self.states.items()):
+            state = state.replace(params=placed)
+            if next_round is not None:
+                state = state.replace(
+                    round_idx=global_put(
+                        np.int32(next_round), self.core.plan.replicated()
+                    )
+                )
+            self.states[name] = state
+
+    def _warm_start(self) -> None:
+        """Round-0 model ingestion: ``Model.modelPath`` via the model repo
+        (reference ``download_model_files`` round-0 branch,
+        ``utils_run_task.py:327-397``)."""
+        template = self._host_params(
+            self.states[self.populations[0].name].params
+        )
+        self._set_params(self.model_io.load_path(self.warm_start_path, template))
+        self.logger.info(
+            task_id=self.task_id, system_name="engine", module_name="runner",
+            message=f"warm-started from {self.warm_start_path}",
+        )
+
+    def _resume_from_exports(self) -> int:
+        """Resume from the newest exported round model (the reference's
+        ``{task_id}_{round}_result_model`` update style) when no Orbax
+        checkpoint claimed the task first.
+
+        Note the fidelity difference from checkpoint resume: the model file
+        carries params only, so a stateful *server* optimizer (FedAdam
+        moments) restarts cold — exactly what the reference's per-round
+        model files give an external aggregator. Probes upward from round 0
+        (first fresh-start probe misses and costs one round-trip; a run that
+        completed r rounds costs r+1 probes against files known to exist).
+        """
+        last = None
+        try:
+            for r in range(self.rounds):
+                if not self.model_io.repo.exists(self.model_io._name(r)):
+                    break
+                last = r
+        except NotImplementedError:
+            # Download-only repos (HTTP) cannot probe; warm start still
+            # works, export-resume does not.
+            return 0
+        if last is None:
+            return 0
+        template = self._host_params(
+            self.states[self.populations[0].name].params
+        )
+        self._set_params(self.model_io.load(last, template), next_round=last + 1)
+        self.logger.info(
+            task_id=self.task_id, system_name="engine", module_name="runner",
+            message=f"resumed from exported round model {last}",
+        )
+        return last + 1
+
     def _try_resume(self) -> int:
         """Restore the latest round checkpoint if one exists; returns the
         round index to resume from (0 when starting fresh)."""
@@ -313,6 +432,22 @@ class SimulationRunner:
         self.checkpointer.save(
             round_idx, self.states, self.personal_states, self.history
         )
+
+    def operator_inputs(self, operator: OperatorSpec) -> Dict[str, Any]:
+        """Named upstream outputs for ``operator`` this round.
+
+        Realizes the operator DAG the validator enforces (``input`` must
+        reference earlier operators — reference ``utils.py:647-651``):
+        each entry maps an upstream operator's name to its per-population
+        record from the CURRENT round (e.g. the train operator's round
+        metrics), so train -> eval -> custom-aggregate chains compose
+        instead of the list merely executing in order.
+        """
+        return {
+            name: self._round_outputs[name]
+            for name in operator.inputs
+            if name in self._round_outputs
+        }
 
     def _call_custom(self, operator: OperatorSpec, round_idx: int,
                      p: DataPopulation) -> Dict[str, Any]:
@@ -358,6 +493,12 @@ class SimulationRunner:
                     jax.random.key(zlib.crc32(self.task_id.encode()) & 0x7FFFFFFF)
                 )
         start_round = self._try_resume()
+        if start_round == 0 and self.model_io is not None:
+            start_round = self._resume_from_exports()
+        if start_round == 0 and self.warm_start_path:
+            # Only a genuinely fresh start ingests the round-0 model; any
+            # resume supersedes it (no wasted fetch on restarts).
+            self._warm_start()
 
         for round_idx in range(start_round, self.rounds):
             if self.stop_event is not None and self.stop_event.is_set():
@@ -372,6 +513,7 @@ class SimulationRunner:
                 raise RuntimeError(f"round {round_idx}: operator-flow start failed")
 
             round_record: Dict[str, Any] = {"round": round_idx}
+            self._round_outputs = {}
             for operator in self.operators:
                 routing_key = self._flow_start(operator, round_idx)
                 ok_by_population: Dict[str, np.ndarray] = {}
@@ -416,9 +558,29 @@ class SimulationRunner:
                 self._flow_complete(routing_key)
                 self._analyze_results(operator, round_idx, ok_by_population)
                 round_record[operator.name] = op_record
+                self._round_outputs[operator.name] = op_record
 
             self.history.append(round_record)
             self._checkpoint(round_idx)
+            if self.model_io is not None and not self._model_io_export_dead:
+                # One global model per task (reference convention); multi-
+                # population tasks export the first population's.
+                try:
+                    self.model_io.export(
+                        round_idx,
+                        self._host_params(
+                            self.states[self.populations[0].name].params
+                        ),
+                    )
+                except NotImplementedError as e:
+                    # Download-only repo (HTTP warm start): ingestion works,
+                    # export cannot — disable it once, loudly.
+                    self._model_io_export_dead = True
+                    self.logger.warning(
+                        task_id=self.task_id, system_name="engine",
+                        module_name="runner",
+                        message=f"model export disabled: {e}",
+                    )
 
             if not self.operator_flow.stop():
                 if self.stop_event is not None and self.stop_event.is_set():

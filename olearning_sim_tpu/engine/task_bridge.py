@@ -112,6 +112,7 @@ def _operator_specs(tc: pb.TaskConfig) -> list:
                 kind=kind,
                 use_deviceflow=op.operationBehaviorController.useController,
                 deviceflow_strategy=op.operationBehaviorController.strategyBehaviorController,
+                outbound_service=op.operationBehaviorController.outboundService,
                 inputs=list(op.input),
             )
         )
@@ -324,6 +325,30 @@ def build_runner_from_taskconfig(
         stop_event=stop_event,
     )
 
+    # Model proto (taskservice.proto Model): warm start + per-round export
+    # named by modelUpdateStyle (reference download_model_files,
+    # utils_run_task.py:327-397).
+    model_io = None
+    warm_start_path = None
+    for op in tc.operatorFlow.operator:
+        m = op.model
+        if not (m.useModel or m.modelUpdateStyle):
+            continue
+        from olearning_sim_tpu.checkpoint import ModelUpdateExporter
+        from olearning_sim_tpu.storage import FileTransferType, make_file_repo
+
+        repo = make_file_repo(
+            FileTransferType(m.modelTransferType), **(params.get("storage") or {})
+        )
+        model_io = ModelUpdateExporter(
+            repo,
+            tc.taskID.taskID,
+            **({"update_style": m.modelUpdateStyle} if m.modelUpdateStyle else {}),
+        )
+        if m.useModel and m.modelPath:
+            warm_start_path = m.modelPath
+        break
+
     return SimulationRunner(
         task_id=tc.taskID.taskID,
         core=core,
@@ -336,4 +361,6 @@ def build_runner_from_taskconfig(
         stop_event=stop_event,
         perf=perf,
         checkpointer=checkpointer,
+        model_io=model_io,
+        warm_start_path=warm_start_path,
     )

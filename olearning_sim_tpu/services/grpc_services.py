@@ -175,9 +175,19 @@ class DeviceFlowServicer:
         return spb.Ack(is_success=self.service.unregister_task(request.task_id))
 
     def NotifyStart(self, request, context) -> spb.Ack:
+        import json as _json
+
+        outbound = None
+        if request.outbound_service:
+            try:
+                outbound = _json.loads(request.outbound_service)
+            except ValueError:
+                return spb.Ack(
+                    is_success=False, message="outbound_service not json"
+                )
         ok, msg = self.service.notify_start(
             request.task_id, request.routing_key, request.compute_resource,
-            request.strategy or "{}",
+            request.strategy or "{}", outbound_service=outbound,
         )
         return spb.Ack(is_success=ok, message=msg or "")
 
@@ -213,10 +223,16 @@ class DeviceFlowClient(_ClientBase):
     def unregister_task(self, task_id) -> bool:
         return self._calls["UnRegisterTask"](spb.TaskRef(task_id=task_id)).is_success
 
-    def notify_start(self, task_id, routing_key, compute_resource, strategy="{}"):
+    def notify_start(self, task_id, routing_key, compute_resource,
+                     strategy="{}", outbound_service=None):
+        import json as _json
+
         ack = self._calls["NotifyStart"](spb.FlowNotifyRequest(
             task_id=task_id, routing_key=routing_key,
-            compute_resource=compute_resource, strategy=strategy))
+            compute_resource=compute_resource, strategy=strategy,
+            outbound_service=(
+                _json.dumps(outbound_service) if outbound_service else ""
+            )))
         return ack.is_success, ack.message
 
     def notify_complete(self, task_id, routing_key, compute_resource):

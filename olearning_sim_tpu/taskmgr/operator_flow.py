@@ -121,11 +121,69 @@ class FlagFileBarrier:
             time.sleep(wait_interval)
 
 
+class WebsocketRoundProvider:
+    """Round provider for :class:`PollingRoundBarrier` that polls an external
+    selection service over WebSocket — the reference's
+    ``waiting_for_global_aggregation`` transport (``operatorflow.py:158-237``:
+    connect, send a query, read ``{"round_idx": N}``).
+
+    Returns ``None`` on any transport/parse error (the barrier keeps
+    polling); the connection is cached across polls and dropped on error.
+    """
+
+    def __init__(
+        self,
+        url: str,
+        query: Optional[Dict[str, Any]] = None,
+        round_key: str = "round_idx",
+        timeout: float = 5.0,
+    ):
+        self.url = url
+        # Request/response poll: every poll sends the query (default {})
+        # and reads one answer — a silent provider would otherwise block
+        # on recv until timeout against request-driven services.
+        self.query = {} if query is None else query
+        self.round_key = round_key
+        self.timeout = timeout
+        self._ws = None
+
+    def _drop(self) -> None:
+        ws, self._ws = self._ws, None
+        if ws is not None:
+            try:
+                ws.close()
+            except Exception:
+                pass
+
+    def __call__(self) -> Optional[int]:
+        import json
+
+        try:
+            if self._ws is None:
+                import websocket  # websocket-client
+
+                self._ws = websocket.create_connection(self.url, timeout=self.timeout)
+            self._ws.send(json.dumps(self.query))
+            resp = json.loads(self._ws.recv())
+            return int(resp[self.round_key])
+        except Exception:
+            self._drop()
+            return None
+
+    close = _drop
+
+
+def _polling_barrier(round_provider=None, selection_url=None,
+                     selection_query=None, round_key="round_idx", **_):
+    if round_provider is None and selection_url:
+        round_provider = WebsocketRoundProvider(
+            selection_url, query=selection_query, round_key=round_key
+        )
+    return PollingRoundBarrier(round_provider)
+
+
 register_flow_strategy("", lambda **_: ImmediateBarrier())
-register_flow_strategy(
-    "waiting_for_global_aggregation",
-    lambda round_provider=None, **_: PollingRoundBarrier(round_provider),
-)
+register_flow_strategy("waiting_for_global_aggregation", _polling_barrier)
 register_flow_strategy(
     "sample_and_aggregation",
     lambda flag_path="aggregation_finished.txt", sampler=None, **_: FlagFileBarrier(

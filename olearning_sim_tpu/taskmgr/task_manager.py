@@ -118,6 +118,8 @@ class TaskManager:
         from olearning_sim_tpu.taskmgr.hybrid import CostModel
 
         self._cost_model = cost_model if cost_model is not None else CostModel()
+        # (task_id, data_name) -> staged device-shard path (hybrid split)
+        self._device_paths: dict = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads = []
@@ -263,6 +265,53 @@ class TaskManager:
         except Exception:  # noqa: BLE001
             return False
 
+    def _stage_hybrid_data(self, tc: pb.TaskConfig) -> None:
+        """Split real datasets between the halves per the (possibly ILP-
+        mutated) allocation (reference HybridDataSplitter,
+        ``utils_runner.py:195-382``): the logical half's ``dataPath`` is
+        rewritten to its disjoint shard, the device shard's path rides to
+        the phone job in ``_device_paths``. Only runs for target data with
+        ``dataSplitType`` set, a real ``dataPath``, and device rounds > 0."""
+        from olearning_sim_tpu.data.hybrid_split import (
+            device_fraction_of,
+            stage_hybrid_split,
+        )
+
+        for td in tc.target.targetData:
+            frac = device_fraction_of(td)
+            if not (td.dataSplitType and td.dataPath and frac > 0.0):
+                continue
+            from olearning_sim_tpu.storage import FileTransferType, make_file_repo
+
+            transfer = FileTransferType(td.dataTransferType)
+            repo = None
+            if transfer != FileTransferType.FILE:
+                repo = make_file_repo(transfer)
+            logical_path, device_path = stage_hybrid_split(
+                td.dataPath, frac, transfer_type=transfer, repo=repo,
+            )
+            self._device_paths[(tc.taskID.taskID, td.dataName)] = device_path
+            td.dataPath = logical_path
+            self.logger.info(
+                task_id=tc.taskID.taskID, system_name="TaskMgr",
+                module_name="hybrid",
+                message=f"{td.dataName}: split {frac:.0%} to device half "
+                        f"({device_path}); logical trains on {logical_path}",
+            )
+
+    def _cleanup_hybrid_staging(self, task_id: str) -> None:
+        """Drop the task's staged hybrid shards (paths + local temp files) —
+        releases otherwise leak one entry and two staged zips per task."""
+        import os
+
+        for key in [k for k in self._device_paths if k[0] == task_id]:
+            path = self._device_paths.pop(key)
+            if os.path.isfile(path):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
     def _submit_device_half(self, tc: pb.TaskConfig) -> bool:
         """Launch the phone (device-simulation) sub-job when the allocation
         routes device-rounds to phones (reference ``submit_phonejob``,
@@ -275,11 +324,17 @@ class TaskManager:
         for td in tc.target.targetData:
             nums = _device_nums(td)
             if nums:
-                device_target.append({
+                entry = {
                     "name": td.dataName,
                     "devices": list(td.totalSimulation.deviceTotalSimulation),
                     "nums": nums,
-                })
+                }
+                staged = self._device_paths.get((task_id, td.dataName))
+                if staged:
+                    # The phone job trains on its own disjoint shard
+                    # (hybrid data split), not the full dataset.
+                    entry["data_path"] = staged
+                device_target.append(entry)
         if not device_target:
             return True
         ok = self._phone_client.submit_task(
@@ -393,6 +448,14 @@ class TaskManager:
                                   module_name="hybrid", message=f"allocation failed: {e}")
                 repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
                 return
+        try:
+            self._stage_hybrid_data(tc)
+        except Exception as e:  # noqa: BLE001
+            self.logger.error(task_id=task_id, system_name="TaskMgr",
+                              module_name="hybrid",
+                              message=f"hybrid data split failed: {e}")
+            repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
+            return
         if repo.get_item_value(task_id, "task_status") == TaskStatus.STOPPED.name:
             return  # stopped while being scheduled
         # Persist the (possibly allocator-mutated) config and the logical
@@ -497,6 +560,7 @@ class TaskManager:
             self._task_repo.set_item_value(
                 task_id, "task_finished_time", time.strftime("%Y-%m-%d %H:%M:%S")
             )
+            self._cleanup_hybrid_staging(task_id)
 
     def interrupt_once(self, now: Optional[float] = None) -> None:
         """Watchdog (reference ``interruptTask``, ``task_manager.py:1150-1200``):

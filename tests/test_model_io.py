@@ -1,0 +1,155 @@
+"""Model proto honoring: warm start from modelPath, per-round export named
+by modelUpdateStyle, resume-from-exported-round (VERDICT missing item #6;
+reference ``download_model_files``, utils_run_task.py:327-397)."""
+
+import json
+
+import numpy as np
+import jax
+import pytest
+
+from olearning_sim_tpu.checkpoint import ModelUpdateExporter, export_model_bytes
+from olearning_sim_tpu.storage import LocalFileRepo
+
+from test_runner import build_runner
+
+
+@pytest.fixture
+def repo(tmp_path):
+    return LocalFileRepo(root=str(tmp_path))
+
+
+def test_export_each_round_with_reference_style(repo):
+    runner = build_runner(rounds=3)
+    runner.model_io = ModelUpdateExporter(
+        repo, runner.task_id,
+        update_style="{task_id}_{current_round}_result_model.msgpack",
+    )
+    runner.run()
+    for r in range(3):
+        assert repo.exists(f"task_e2e_{r}_result_model.msgpack")
+
+
+def test_resume_from_exported_round_model(repo):
+    r1 = build_runner(rounds=2)
+    r1.model_io = ModelUpdateExporter(repo, r1.task_id)
+    r1.run()
+    params_after_2 = jax.device_get(r1.states["data_0"].params)
+
+    # Fresh runner for the same task, more rounds: must pick up at round 2
+    # with exactly the exported params, not round 0.
+    r2 = build_runner(rounds=4)
+    r2.model_io = ModelUpdateExporter(repo, r2.task_id)
+    history = r2.run()
+    assert [h["round"] for h in history] == [2, 3]
+    # rounds 2 and 3 exported too
+    assert repo.exists(r2.model_io._name(3))
+
+
+def test_warm_start_from_model_path(repo, tmp_path):
+    donor = build_runner(rounds=1)
+    donor.run()
+    blob = export_model_bytes(donor.states["data_0"].params)
+    (tmp_path / "warm.msgpack").write_bytes(blob)
+
+    r = build_runner(rounds=1)
+    r.model_io = ModelUpdateExporter(repo, "other_task")
+    r.warm_start_path = "warm.msgpack"
+    # pin the behavior directly: after _warm_start the params ARE the donor's
+    import jax.random
+
+    r.states["data_0"] = r.core.init_state(jax.random.key(99))
+    r._warm_start()
+    donor_params = jax.device_get(donor.states["data_0"].params)
+    warm_params = jax.device_get(r.states["data_0"].params)
+    jax.tree.map(np.testing.assert_array_equal, donor_params, warm_params)
+
+    # and run() applies it on a fresh start (trajectory != fresh-init run)
+    r2 = build_runner(rounds=1)
+    r2.model_io = ModelUpdateExporter(repo, "other_task2")
+    r2.warm_start_path = "warm.msgpack"
+    r2.run()
+    r_fresh = build_runner(rounds=1)
+    r_fresh.run()
+    fresh_leaf = jax.tree.leaves(jax.device_get(r_fresh.states["data_0"].params))[0]
+    warm_leaf = jax.tree.leaves(jax.device_get(r2.states["data_0"].params))[0]
+    assert not np.allclose(warm_leaf, fresh_leaf)
+
+
+def test_warm_start_requires_repo():
+    from olearning_sim_tpu.engine.runner import SimulationRunner
+
+    r = build_runner(rounds=1)
+    with pytest.raises(ValueError, match="model_io"):
+        SimulationRunner(
+            task_id="t", core=r.core, populations=r.populations,
+            operators=r.operators, rounds=1, warm_start_path="x.msgpack",
+        )
+
+
+def test_export_resume_advances_round_counter(repo):
+    """The device round counter (every client RNG stream folds it in) must
+    move with the ingested round model, not stay at 0."""
+    r1 = build_runner(rounds=2)
+    r1.model_io = ModelUpdateExporter(repo, r1.task_id)
+    r1.run()
+    r2 = build_runner(rounds=4)
+    r2.model_io = ModelUpdateExporter(repo, r2.task_id)
+    r2.run()
+    assert int(jax.device_get(r2.states["data_0"].round_idx)) == 4
+
+
+def test_task_bridge_wires_model_io(tmp_path):
+    """modelUpdateStyle + useModel/modelPath in the task JSON reach the
+    runner through the bridge."""
+    from olearning_sim_tpu.engine.task_bridge import build_runner_from_taskconfig
+
+    donor = build_runner(rounds=1)
+    donor.run()
+    blob = export_model_bytes(
+        jax.device_get(donor.states["data_0"].params)
+    )
+    # template-compatible model for mlp2 default used by the bridge
+    task = {
+        "user_id": "t", "task_id": "task_model_io",
+        "target": {"priority": 1, "data": [{
+            "name": "data_0", "data_path": "",
+            "data_split_type": False, "data_transfer_type": "FILE",
+            "task_type": "classification",
+            "total_simulation": {"devices": ["hpc"], "nums": [8], "dynamic_nums": [0]},
+            "allocation": {"optimization": False, "logical_simulation": [8],
+                            "device_simulation": [0],
+                            "running_response": {"devices": [], "nums": []}},
+        }]},
+        "operatorflow": {
+            "flow_setting": {"round": 1,
+                "start": {"logical_simulation": {"strategy": "", "wait_interval": 0, "total_timeout": 0},
+                           "device_simulation": {"strategy": "", "wait_interval": 0, "total_timeout": 0}},
+                "stop": {"logical_simulation": {"strategy": "", "wait_interval": 0, "total_timeout": 0},
+                          "device_simulation": {"strategy": "", "wait_interval": 0, "total_timeout": 0}}},
+            "operators": [{"name": "train", "input": [],
+                "model": {"use_model": False, "model_for_train": True,
+                           "model_transfer_type": "FILE", "model_path": "",
+                           "model_update_style": "{task_id}_{round}_m.msgpack"},
+                "logical_simulation": {"simulation_num": 8,
+                    "operator_code_path": "builtin:train",
+                    "operator_entry_file": "",
+                    "operator_transfer_type": "FILE",
+                    "operator_params": json.dumps({
+                        "model": {"name": "mlp2", "overrides": {"hidden": [16], "num_classes": 3},
+                                   "input_shape": [12]},
+                        "algorithm": {"name": "fedavg", "local_lr": 0.1},
+                        "fedcore": {"batch_size": 4, "max_local_steps": 2, "block_clients": 2},
+                        "data": {"synthetic": {"seed": 3, "n_local": 10, "num_classes": 3,
+                                                "class_sep": 4.0}},
+                        "storage": {"root": str(tmp_path)},
+                    })},
+                "device_simulation": {}, "operation_behavior_controller": {
+                    "use_gradient_house": False, "strategy_gradient_house": ""}}],
+        },
+    }
+    runner = build_runner_from_taskconfig(task)
+    assert runner.model_io is not None
+    runner.run()
+    import os
+    assert os.path.exists(str(tmp_path / "task_model_io_0_m.msgpack"))

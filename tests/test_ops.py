@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-from olearning_sim_tpu.ops import flash_attention, weighted_sum
+from olearning_sim_tpu.ops import flash_attention
 from olearning_sim_tpu.parallel.ring_attention import RingSelfAttention, ring_attention
 
 
@@ -68,26 +68,8 @@ def test_flash_fully_masked_rows_zero():
 
 
 # ------------------------------------------------------------- aggregation
-def test_weighted_sum_matches_einsum():
-    rng = np.random.default_rng(0)
-    u = rng.standard_normal((37, 300)).astype(np.float32)
-    w = rng.random(37).astype(np.float32)
-    w[5] = 0.0  # masked client
-    out = weighted_sum(jnp.asarray(u), jnp.asarray(w), interpret=True)
-    np.testing.assert_allclose(np.asarray(out), w @ u, rtol=1e-5, atol=1e-4)
 
 
-def test_weighted_sum_bf16_updates():
-    rng = np.random.default_rng(1)
-    u = jnp.asarray(rng.standard_normal((16, 512)), jnp.bfloat16)
-    w = jnp.asarray(rng.random(16), jnp.float32)
-    out = weighted_sum(u, w, interpret=True)
-    assert out.dtype == jnp.float32  # f32 accumulation
-    ref = np.asarray(w)[None, :] @ np.asarray(u, np.float32)
-    np.testing.assert_allclose(np.asarray(out), ref[0], rtol=2e-2, atol=2e-1)
-
-
-# ------------------------------------------------------------------- ring
 def _ring_apply(q, k, v, mask, sp):
     mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
 

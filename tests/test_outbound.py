@@ -1,0 +1,305 @@
+"""Cluster-mode deviceflow outbound (VERDICT missing #2) and the
+selection-service WebSocket round barrier (VERDICT missing #5).
+
+Integration shape: a real WebSocket / gRPC server plays the external
+aggregator (reference: Pulsar/WS producers, message_producer.py:42-78;
+selection service, operatorflow.py:158-237); the deviceflow service
+delivers the behavior-shaped stream to it over the network.
+"""
+
+import base64
+import json
+import threading
+import time
+from concurrent import futures
+
+import pytest
+
+from olearning_sim_tpu.deviceflow import DeviceFlowService
+from olearning_sim_tpu.deviceflow.outbound import (
+    GrpcOutboundProducer,
+    WebsocketProducer,
+    make_outbound_factory,
+)
+from olearning_sim_tpu.taskmgr.operator_flow import (
+    OperatorFlowController,
+    WebsocketRoundProvider,
+)
+
+
+def wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ------------------------------------------------------------ fake servers
+class WsCollector:
+    """Real WebSocket server collecting text frames (websockets.sync)."""
+
+    def __init__(self):
+        from websockets.sync.server import serve
+
+        self.frames = []
+        self._server = serve(self._handler, "127.0.0.1", 0)
+        self.port = self._server.socket.getsockname()[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def _handler(self, ws):
+        try:
+            for frame in ws:
+                self.frames.append(frame)
+        except Exception:
+            pass
+
+    @property
+    def url(self):
+        return f"ws://127.0.0.1:{self.port}"
+
+    def close(self):
+        self._server.shutdown()
+
+
+class WsRoundService:
+    """Selection-service stand-in: answers every incoming query with the
+    current round index JSON."""
+
+    def __init__(self, round_key="round_idx"):
+        from websockets.sync.server import serve
+
+        self.round_idx = 0
+        self.round_key = round_key
+        self.queries = []
+        self._server = serve(self._handler, "127.0.0.1", 0)
+        self.port = self._server.socket.getsockname()[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def _handler(self, ws):
+        try:
+            for frame in ws:
+                self.queries.append(json.loads(frame))
+                ws.send(json.dumps({self.round_key: self.round_idx}))
+        except Exception:
+            pass
+
+    @property
+    def url(self):
+        return f"ws://127.0.0.1:{self.port}"
+
+    def close(self):
+        self._server.shutdown()
+
+
+class GrpcSink:
+    """Real OutboundSink gRPC server collecting batches."""
+
+    def __init__(self):
+        import grpc
+
+        from olearning_sim_tpu.proto import services_pb2 as spb
+
+        self.batches = []
+
+        def publish(request, context):
+            self.batches.append((request.flow_id, list(request.messages)))
+            return spb.Ack(is_success=True)
+
+        handler = grpc.method_handlers_generic_handler(
+            "olearning_sim_tpu.services.OutboundSink",
+            {
+                "PublishBatch": grpc.unary_unary_rpc_method_handler(
+                    publish,
+                    request_deserializer=spb.OutboundBatch.FromString,
+                    response_serializer=spb.Ack.SerializeToString,
+                )
+            },
+        )
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.start()
+
+    @property
+    def target(self):
+        return f"127.0.0.1:{self.port}"
+
+    def close(self):
+        self._server.stop(None)
+
+
+# -------------------------------------------------------------- producers
+def test_websocket_producer_pulsar_ws_format():
+    srv = WsCollector()
+    try:
+        prod = WebsocketProducer(srv.url)
+        prod([{"grad": [1, 2]}, "raw-string"])
+        prod.close()
+        assert wait_until(lambda: len(srv.frames) == 2)
+        first = json.loads(srv.frames[0])
+        assert set(first) == {"payload"}  # reference WS-producer format
+        assert json.loads(base64.b64decode(first["payload"])) == {"grad": [1, 2]}
+        assert base64.b64decode(json.loads(srv.frames[1])["payload"]) == b"raw-string"
+    finally:
+        srv.close()
+
+
+def test_grpc_producer_round_trip():
+    sink = GrpcSink()
+    try:
+        prod = GrpcOutboundProducer(sink.target, flow_id="t1_op_0")
+        prod([{"a": 1}, {"b": 2}])
+        prod([{"c": 3}])
+        prod.close()
+        assert len(sink.batches) == 2
+        assert sink.batches[0][0] == "t1_op_0"
+        assert [json.loads(m) for m in sink.batches[0][1]] == [{"a": 1}, {"b": 2}]
+    finally:
+        sink.close()
+
+
+def test_factory_dispatch():
+    fallback_calls = []
+    factory = make_outbound_factory(
+        fallback=lambda fid, cfg: fallback_calls.append((fid, cfg)) or (lambda b: None)
+    )
+    assert isinstance(factory("f", {"type": "websocket", "url": "ws://x"}), WebsocketProducer)
+    factory("f", {"type": "memory"})
+    assert fallback_calls and fallback_calls[0][0] == "f"
+    with pytest.raises(ValueError):
+        make_outbound_factory()("f", {"type": "pulsar"})
+
+
+# ------------------------------------------------- service-level integration
+def test_deviceflow_streams_to_external_websocket():
+    """External aggregator receives the dispatched behavior-shaped stream
+    over the network — the cluster-mode path end to end."""
+    srv = WsCollector()
+    svc = DeviceFlowService(poll_interval=0.01)
+    svc.start()
+    try:
+        assert svc.register_task("t1", ["logical_simulation"])
+        strategy = json.dumps({
+            "real_time_dispatch": {"use_strategy": True, "dispatch_batch_sizes": [5]}
+        })
+        ok, msg = svc.notify_start(
+            "t1", "t1_op_0", "logical_simulation", strategy,
+            outbound_service={"type": "websocket", "url": srv.url},
+        )
+        assert ok, msg
+        for i in range(12):
+            svc.publish("t1_op_0", "logical_simulation", {"update": i})
+        ok, _ = svc.notify_complete("t1", "t1_op_0", "logical_simulation")
+        assert ok
+        assert wait_until(lambda: svc.check_dispatch_finished("t1"))
+        assert wait_until(lambda: len(srv.frames) == 12)
+        got = [json.loads(base64.b64decode(json.loads(f)["payload"]))
+               for f in srv.frames]
+        assert got[0] == {"update": 0} and got[-1] == {"update": 11}
+        # nothing leaked into the in-memory collector
+        assert "t1_op_0" not in svc.delivered
+    finally:
+        svc.stop()
+        srv.close()
+
+
+def test_deviceflow_streams_to_grpc_sink():
+    sink = GrpcSink()
+    svc = DeviceFlowService(poll_interval=0.01)
+    svc.start()
+    try:
+        assert svc.register_task("t2", ["logical_simulation"])
+        strategy = json.dumps({
+            "real_time_dispatch": {"use_strategy": True, "dispatch_batch_sizes": [4]}
+        })
+        ok, msg = svc.notify_start(
+            "t2", "t2_op_0", "logical_simulation", strategy,
+            outbound_service={"type": "grpc", "target": sink.target},
+        )
+        assert ok, msg
+        for i in range(9):
+            svc.publish("t2_op_0", "logical_simulation", {"u": i})
+        ok, _ = svc.notify_complete("t2", "t2_op_0", "logical_simulation")
+        assert ok
+        assert wait_until(lambda: svc.check_dispatch_finished("t2"))
+        assert wait_until(
+            lambda: sum(len(b[1]) for b in sink.batches) == 9
+        )
+        # real_time batching preserved: batches of 4 + leftover drain
+        sizes = sorted(len(b[1]) for b in sink.batches)
+        assert sizes == [1, 4, 4]
+    finally:
+        svc.stop()
+        sink.close()
+
+
+# ----------------------------------------------- selection-service barrier
+def test_websocket_round_provider_and_barrier():
+    srv = WsRoundService()
+    try:
+        provider = WebsocketRoundProvider(srv.url, query={"task": "t1"})
+        assert provider() == 0
+        srv.round_idx = 7
+        assert provider() == 7
+        assert srv.queries[0] == {"task": "t1"}
+
+        flow = OperatorFlowController(
+            "t1", rounds=3,
+            start_params={"strategy": "waiting_for_global_aggregation",
+                           "wait_interval": 0.02, "total_timeout": 5},
+            stop_params={"strategy": "waiting_for_global_aggregation",
+                          "wait_interval": 0.02, "total_timeout": 5},
+            strategy_kwargs={"selection_url": srv.url},
+        )
+        assert flow.start()  # any answer accepted for start
+        # stop requires the service round to advance by exactly 1
+        done = {}
+
+        def advance():
+            time.sleep(0.2)
+            srv.round_idx = 8
+            done["t"] = time.monotonic()
+
+        threading.Thread(target=advance).start()
+        assert flow.stop()
+        assert "t" in done  # barrier genuinely waited for the advance
+    finally:
+        srv.close()
+
+
+def test_websocket_round_provider_unreachable_returns_none():
+    provider = WebsocketRoundProvider("ws://127.0.0.1:1/never", timeout=0.2)
+    assert provider() is None
+
+
+def test_bad_outbound_config_fails_only_that_flow():
+    """A malformed outbound config must not kill the dispatch loop for
+    other tasks' flows."""
+    svc = DeviceFlowService(poll_interval=0.01)
+    svc.start()
+    try:
+        strategy = json.dumps({
+            "real_time_dispatch": {"use_strategy": True, "dispatch_batch_sizes": [4]}
+        })
+        assert svc.register_task("bad", ["logical_simulation"])
+        ok, _ = svc.notify_start(
+            "bad", "bad_op_0", "logical_simulation", strategy,
+            outbound_service={"type": "websocket"},  # missing url
+        )
+        assert ok
+        svc.publish("bad_op_0", "logical_simulation", {"u": 0})
+        svc.notify_complete("bad", "bad_op_0", "logical_simulation")
+        # healthy flow on the same service still dispatches
+        assert svc.register_task("good", ["logical_simulation"])
+        ok, _ = svc.notify_start("good", "good_op_0", "logical_simulation", strategy)
+        assert ok
+        for i in range(4):
+            svc.publish("good_op_0", "logical_simulation", {"u": i})
+        svc.notify_complete("good", "good_op_0", "logical_simulation")
+        assert wait_until(lambda: len(svc.delivered.get("good_op_0", [])) == 4)
+        assert not svc.check_dispatch_finished("bad")  # failed, not finished
+    finally:
+        svc.stop()

@@ -224,3 +224,30 @@ def test_final_round_stop_tolerance():
     runner2.operator_flow = flow2
     history = runner2.run()  # single round: tolerated
     assert len(history) == 1
+
+
+def test_operator_dag_inputs_compose():
+    """train -> eval -> custom chain: the custom operator consumes the train
+    operator's round metrics through its declared `input` (the DAG the
+    validator enforces, reference utils.py:647-651)."""
+    seen = []
+
+    def aggregate(runner, round_idx, operator, population):
+        ins = runner.operator_inputs(operator)
+        assert set(ins) == {"train"}
+        train_rec = ins["train"][population.name]
+        seen.append((round_idx, float(train_rec["mean_loss"])))
+        return {"consumed_loss": float(train_rec["mean_loss"])}
+
+    ops = [
+        OperatorSpec(name="train", kind="train"),
+        OperatorSpec(name="evaluate", kind="eval", inputs=["train"]),
+        OperatorSpec(name="agg", kind="custom", inputs=["train"],
+                     custom_fn=aggregate),
+    ]
+    runner = build_runner(rounds=2, operators=ops)
+    history = runner.run()
+    assert len(seen) == 2
+    for h, (r, loss) in zip(history, seen):
+        assert h["agg"]["data_0"]["consumed_loss"] == loss
+        assert loss == h["train"]["data_0"]["mean_loss"]
