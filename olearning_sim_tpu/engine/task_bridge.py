@@ -62,7 +62,7 @@ def _engine_params(tc: pb.TaskConfig) -> Dict[str, Any]:
     return {}
 
 
-def _operator_specs(tc: pb.TaskConfig) -> list:
+def _operator_specs(tc: pb.TaskConfig, storage: Optional[Dict[str, Any]] = None) -> list:
     specs = []
     for op in tc.operatorFlow.operator:
         info = op.logicalSimulationOperatorInfo
@@ -87,7 +87,10 @@ def _operator_specs(tc: pb.TaskConfig) -> list:
             if os.path.isdir(path):
                 code_dir = path
             else:
-                repo = make_file_repo(FileTransferType(info.operatorTransferType))
+                repo = make_file_repo(
+                    FileTransferType(info.operatorTransferType),
+                    **(storage or {}),
+                )
                 code_dir = fetch_operator_code(
                     repo, path, tempfile.mkdtemp(prefix=f"op_{op.name}_")
                 )
@@ -353,7 +356,7 @@ def build_runner_from_taskconfig(
         task_id=tc.taskID.taskID,
         core=core,
         populations=populations,
-        operators=_operator_specs(tc),
+        operators=_operator_specs(tc, storage=params.get("storage")),
         rounds=fs.round,
         task_repo=task_repo,
         deviceflow=deviceflow,

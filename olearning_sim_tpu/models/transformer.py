@@ -42,8 +42,12 @@ class TransformerBlock(nn.Module):
                     "'dense' on builds without it"
                 ) from e
 
+            # Named to match the dense branch's auto-name so dense-trained
+            # params apply unchanged under ring attention (long-context
+            # eval of a model trained with attention_impl="dense").
             y = RingSelfAttention(
-                num_heads=self.heads, dtype=self.dtype
+                num_heads=self.heads, dtype=self.dtype,
+                name="MultiHeadDotProductAttention_0",
             )(x, pad_mask)
         elif self.attention_impl == "flash":
             # Fused Pallas kernel: no HBM score tensor. Slower than XLA's
@@ -88,7 +92,13 @@ class TextTransformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens):
-        # tokens: [B, L] int32.
+        # tokens: [B, L] int32. Under attention_impl="ring" this runs inside
+        # shard_map with L sharded over the "sp" mesh axis: tokens is the
+        # LOCAL chunk, positions are offset by the rank's chunk start, and
+        # the mean-pool reduces over the global sequence via psum.
+        import jax
+
+        ring = self.attention_impl == "ring"
         pad_mask = tokens != self.pad_id
         emb = nn.Embed(
             self.vocab_size, self.width,
@@ -101,7 +111,13 @@ class TextTransformer(nn.Module):
             (1, self.max_len, self.width),
             jnp.float32,
         )
-        x = (emb + pos[:, : tokens.shape[1]]).astype(self.dtype)
+        L = tokens.shape[1]
+        if ring:
+            offset = jax.lax.axis_index("sp") * L
+            pos_slice = jax.lax.dynamic_slice_in_dim(pos, offset, L, axis=1)
+        else:
+            pos_slice = pos[:, :L]
+        x = (emb + pos_slice).astype(self.dtype)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         for _ in range(self.depth):
             x = TransformerBlock(
@@ -111,7 +127,12 @@ class TextTransformer(nn.Module):
         # Mean-pool over real tokens (robust when no CLS convention exists in
         # the synthetic/Sent140 tokenization).
         m = pad_mask[..., None].astype(jnp.float32)
-        pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+        s = (x.astype(jnp.float32) * m).sum(1)
+        c = m.sum(1)
+        if ring:
+            s = jax.lax.psum(s, "sp")
+            c = jax.lax.psum(c, "sp")
+        pooled = s / jnp.maximum(c, 1.0)
         return nn.Dense(self.num_classes, dtype=jnp.float32)(pooled)
 
 

@@ -1,0 +1,115 @@
+"""Long-context sequence-parallel forward: run the text family over
+sequences too long for one device's HBM.
+
+The FL training path keeps dense attention (device-class models see short
+sequences — SURVEY.md section 5: client count, not sequence length, is the
+platform's scaling axis). This module is the reachable surface for the
+long-context machinery (:mod:`ring_attention`): central evaluation /
+inference of a global model over arbitrarily long inputs, with the sequence
+axis sharded over the mesh ``sp`` axis and K/V chunks rotating around the
+ring with ``ppermute`` — per-device attention memory is O(L/sp) and the
+transfers ride ICI neighbor links.
+
+Because :class:`RingSelfAttention` is parameter-compatible with the dense
+path, the SAME params trained with ``attention_impl="dense"`` evaluate here
+unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from olearning_sim_tpu.parallel.mesh import MeshPlan, global_put
+
+
+def sp_forward(model, params, tokens, plan: MeshPlan):
+    """Forward the text ``model`` (built with ``attention_impl="ring"``)
+    over ``tokens`` [B, L] with L sharded over the plan's ``sp`` axis and
+    the batch over ``dp``. Returns logits [B, num_classes].
+
+    ``L`` must divide ``sp`` and ``B`` must divide ``dp`` (pad with the
+    model's pad_id / duplicate rows if not — padding tokens are masked out
+    of attention and pooling by construction).
+    """
+    if plan.sp <= 1:
+        raise ValueError("sp_forward needs a mesh with an sp axis (make_mesh_plan(sp=...))")
+    B, L = tokens.shape
+    if L % plan.sp:
+        raise ValueError(
+            f"sp={plan.sp} must divide the sequence length {L}; pad the "
+            f"sequences (pad_id tokens are masked out)"
+        )
+    if B % plan.dp:
+        raise ValueError(f"dp={plan.dp} must divide the batch {B}")
+    max_len = getattr(model, "max_len", None)
+    if max_len is not None and L > max_len:
+        # The ring path's positional dynamic_slice would clamp out-of-range
+        # offsets and silently reuse early positions.
+        raise ValueError(
+            f"global sequence length {L} exceeds the model's max_len "
+            f"{max_len}; build the model with max_len >= {L}"
+        )
+
+    tokens = global_put(
+        np.asarray(tokens), NamedSharding(plan.mesh, P("dp", "sp"))
+    )
+    return _compiled_forward(model, plan.mesh)(params, tokens)
+
+
+# flax Modules and Meshes hash by value, so identical (model, mesh) pairs
+# reuse the compiled program across calls (sp_evaluate loops batches —
+# rebuilding the jit closure per call would retrace and recompile every
+# time).
+_FWD_CACHE: dict = {}
+
+
+def _compiled_forward(model, mesh):
+    key = (model, mesh)
+    if key not in _FWD_CACHE:
+        def body(params, tokens_chunk):
+            # logits are replicated over sp after the model's pooling psum.
+            return model.apply({"params": params}, tokens_chunk)
+
+        _FWD_CACHE[key] = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(), P("dp", "sp")),
+                out_specs=P("dp"),
+                axis_names=frozenset({"dp", "sp"}),
+            )
+        )
+    return _FWD_CACHE[key]
+
+
+def sp_evaluate(model, params, tokens, labels, plan: MeshPlan,
+                batch: Optional[int] = None) -> Tuple[float, float]:
+    """Central eval (loss, accuracy) of a text model over long sequences,
+    batched host-side."""
+    import optax
+
+    n = tokens.shape[0]
+    batch = batch or n
+    # Pad the batch so every slice divides dp (padded rows weighted 0).
+    losses = accs = seen = 0.0
+    for i in range(0, n, batch):
+        tb, yb = tokens[i : i + batch], labels[i : i + batch]
+        real = len(yb)
+        pad = (-real) % plan.dp
+        if pad:
+            tb = np.concatenate([tb, np.repeat(tb[-1:], pad, 0)])
+            yb = np.concatenate([yb, np.repeat(yb[-1:], pad, 0)])
+        logits = jax.device_get(sp_forward(model, params, tb, plan))[:real]
+        losses += float(
+            optax.softmax_cross_entropy_with_integer_labels(
+                jnp.asarray(logits), jnp.asarray(yb[:real])
+            ).sum()
+        )
+        accs += float((logits.argmax(-1) == yb[:real]).sum())
+        seen += real
+    return losses / seen, accs / seen

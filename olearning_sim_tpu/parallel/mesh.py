@@ -42,8 +42,13 @@ class MeshPlan:
         return self.mesh.shape["mp"]
 
     @property
+    def sp(self) -> int:
+        """Sequence-parallel axis size (1 when absent — dp/mp-only plans)."""
+        return self.mesh.shape.get("sp", 1)
+
+    @property
     def n_devices(self) -> int:
-        return self.dp * self.mp
+        return self.dp * self.mp * self.sp
 
     def client_sharding(self) -> NamedSharding:
         """Arrays with a leading client axis: sharded over ``dp``."""
@@ -63,23 +68,32 @@ def make_mesh_plan(
     devices: Optional[Sequence[jax.Device]] = None,
     dp: Optional[int] = None,
     mp: int = 1,
+    sp: int = 1,
 ) -> MeshPlan:
-    """Build a ``(dp, mp)`` mesh over the given devices (default: all).
+    """Build a ``(dp, mp[, sp])`` mesh over the given devices (default: all).
 
-    ``dp`` defaults to ``len(devices) // mp``. Device order follows
-    ``jax.devices()`` which is already topology-sorted for ICI adjacency.
+    ``dp`` defaults to ``len(devices) // (mp * sp)``. Device order follows
+    ``jax.devices()`` which is already topology-sorted for ICI adjacency —
+    ``sp`` is the minor axis so ring-attention ppermute hops ride neighbor
+    links. The ``sp`` axis only exists when ``sp > 1`` (dp/mp plans keep
+    their two-axis mesh).
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
-    if mp <= 0:
-        raise ValueError(f"mp must be positive, got {mp}")
+    if mp <= 0 or sp <= 0:
+        raise ValueError(f"mp and sp must be positive, got mp={mp} sp={sp}")
     if dp is None:
-        dp = len(devices) // mp
-    if dp * mp > len(devices):
-        raise ValueError(f"mesh {dp}x{mp} needs {dp * mp} devices, have {len(devices)}")
-    grid = np.asarray(devices[: dp * mp]).reshape(dp, mp)
-    return MeshPlan(mesh=Mesh(grid, ("dp", "mp")))
+        dp = len(devices) // (mp * sp)
+    if dp * mp * sp > len(devices):
+        raise ValueError(
+            f"mesh {dp}x{mp}x{sp} needs {dp * mp * sp} devices, have {len(devices)}"
+        )
+    if sp == 1:
+        grid = np.asarray(devices[: dp * mp]).reshape(dp, mp)
+        return MeshPlan(mesh=Mesh(grid, ("dp", "mp")))
+    grid = np.asarray(devices[: dp * mp * sp]).reshape(dp, mp, sp)
+    return MeshPlan(mesh=Mesh(grid, ("dp", "mp", "sp")))
 
 
 def global_put(x, sharding: NamedSharding):

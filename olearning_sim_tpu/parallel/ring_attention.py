@@ -62,16 +62,13 @@ def ring_attention(
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     qf = q.astype(jnp.float32)
-    # Accumulators start as replicated constants; type them device-varying
-    # over the ring axis so the scan carry types match (shard_map VMA).
-    m0, l0, acc0 = jax.lax.pvary(
-        (
-            jnp.full((B, H, Lc, 1), NEG_INF, jnp.float32),
-            jnp.zeros((B, H, Lc, 1), jnp.float32),
-            jnp.zeros((B, H, Lc, D), jnp.float32),
-        ),
-        axis_name,
-    )
+    # Accumulators derive from q (full_like/zeros_like) so their varying-
+    # manual-axes type matches the scan body's outputs under ANY enclosing
+    # shard_map (sp alone, dp x sp, ...) — a pvary over just the ring axis
+    # would mismatch when other manual axes are present.
+    m0 = jnp.full_like(qf[..., :1], NEG_INF)
+    l0 = jnp.zeros_like(qf[..., :1])
+    acc0 = jnp.zeros_like(qf)
 
     def step(carry, _):
         k_cur, v_cur, mask_cur, m, l, acc = carry
@@ -108,6 +105,12 @@ class RingSelfAttention(nn.Module):
     ``models/transformer.py``). Must be applied inside shard_map with the
     L axis of its input sharded on that mesh axis; projections are local
     (per-token), so only attention itself communicates.
+
+    Parameter-compatible with ``nn.MultiHeadDotProductAttention``
+    (submodules ``query``/``key``/``value`` with kernels [W, H, D] and
+    ``out`` with kernel [H, D, W]) — a model trained with dense attention
+    applies unchanged with ``attention_impl='ring'`` for long-context
+    inference/eval.
     """
 
     num_heads: int
@@ -119,13 +122,16 @@ class RingSelfAttention(nn.Module):
         # x: [B, Lc, W] local chunk; pad_mask: [B, Lc].
         B, Lc, W = x.shape
         head_dim = W // self.num_heads
-        qkv = nn.DenseGeneral(
-            features=(3, self.num_heads, head_dim), axis=-1, dtype=self.dtype,
-            name="qkv",
-        )(x)                                       # [B, Lc, 3, H, D]
-        q, k, v = [
-            jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3)
-        ]                                          # each [B, H, Lc, D]
+        proj = lambda name: nn.DenseGeneral(
+            features=(self.num_heads, head_dim), axis=-1, dtype=self.dtype,
+            name=name,
+        )
+        q, k, v = (
+            jnp.moveaxis(proj(n)(x), 2, 1)         # [B, H, Lc, D]
+            for n in ("query", "key", "value")
+        )
         o = ring_attention(q, k, v, pad_mask, self.axis_name)
-        o = jnp.moveaxis(o, 1, 2).reshape(B, Lc, W)
-        return nn.Dense(W, dtype=self.dtype, name="out")(o)
+        o = jnp.moveaxis(o, 1, 2)                  # [B, Lc, H, D]
+        return nn.DenseGeneral(
+            features=W, axis=(-2, -1), dtype=self.dtype, name="out"
+        )(o)

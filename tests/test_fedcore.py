@@ -118,3 +118,36 @@ def test_padding_weights_zero():
     w = np.asarray(ds.weight)
     assert (w[10:] == 0).all()
     assert (w[:10] > 0).all()
+
+
+def test_gather_and_multiplicity_modes_agree():
+    """The two minibatch realizations draw the same indices and must produce
+    the same training trajectory (identical math up to float reduction
+    order) — the exactness claim behind FedCoreConfig.sample_mode."""
+    results = {}
+    for mode in ("gather", "multiplicity"):
+        plan = make_mesh_plan(dp=8, mp=1)
+        cfg = FedCoreConfig(batch_size=8, max_local_steps=3, block_clients=4,
+                            sample_mode=mode)
+        core = build_fedcore(
+            "mlp2", fedavg(0.1), plan, cfg,
+            model_overrides={"hidden": (32,), "num_classes": NUM_CLASSES},
+            input_shape=INPUT_SHAPE,
+        )
+        ds = make_synthetic_dataset(
+            SEED, 32, 12, INPUT_SHAPE, NUM_CLASSES, class_sep=4.0,
+            num_samples_range=(4, 12),  # heterogeneity: idx drawn in [0, n_c)
+        ).pad_for(plan, 4).place(plan, feature_dtype=None)
+        state = core.init_state(jax.random.key(7))
+        for _ in range(2):
+            state, metrics = core.round_step(state, ds)
+        results[mode] = (
+            jax.device_get(state.params), float(metrics.mean_loss)
+        )
+    pg, lg = results["gather"]
+    pm, lm = results["multiplicity"]
+    assert lg == pytest.approx(lm, rel=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-3, rtol=1e-3),
+        pg, pm,
+    )
