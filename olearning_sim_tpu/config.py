@@ -114,7 +114,10 @@ def build_session(cfg: Dict[str, Any]):
     address = session_cfg.get("address", "127.0.0.1:0")
 
     repos = cfg.get("repos", {})
-    sqlite_path = repos.get("sqlite_path")
+    # OLS_SQLITE_PATH overrides the config file's path so one shared config
+    # can be mounted read-only while the deployment points state at its own
+    # volume (deploy/k8s/platform.yaml sets it to the PVC mount).
+    sqlite_path = os.environ.get("OLS_SQLITE_PATH") or repos.get("sqlite_path")
 
     if cfg.get("storage"):
         apply_storage_env(cfg["storage"])
@@ -202,6 +205,16 @@ def build_session(cfg: Dict[str, Any]):
 
         tm_cfg = dict(cfg.get("taskmgr", {}))
         task_repo = TaskTableRepo(sqlite_path=sqlite_path) if sqlite_path else None
+        # Alternate non-gRPC intake (reference RedisRepo path): a durable
+        # sqlite FIFO any local producer can push task JSON onto.
+        intake_queue = None
+        intake_path = os.environ.get("OLS_INTAKE_QUEUE_PATH") or repos.get(
+            "intake_queue_path"
+        )
+        if intake_path:
+            from olearning_sim_tpu.taskmgr.queue_repo import SqliteQueueRepo
+
+            intake_queue = SqliteQueueRepo(intake_path)
         task_manager = TaskManager(
             task_repo=task_repo,
             resource_manager=resource_manager,
@@ -216,6 +229,7 @@ def build_session(cfg: Dict[str, Any]):
             interrupt_running_time=float(
                 tm_cfg.get("interrupt_running_time", 172800.0)
             ),
+            intake_queue=intake_queue,
         )
 
     return SimulatorSession(

@@ -105,6 +105,12 @@ class FedCoreConfig:
     # when n_local <= 2 * batch_size (profiling: the gather alone cost
     # ~4.6ms per 128-client block-step on v5e).
     sample_mode: str = "auto"
+    # lax.scan unroll factor for the local-SGD step loop. Unrolling lets XLA
+    # fuse/pipeline across sequential steps (the per-step tensors are small,
+    # so scan's one-iteration window otherwise leaves the scalar units and
+    # DMA idle between convs). Measured on v5e at the headline config:
+    # unroll=5 with block_clients=64 lifted 0.45 -> 0.60 rounds/sec.
+    step_unroll: int = 1
 
     def use_multiplicity(self, n_local: int) -> bool:
         if self.sample_mode == "multiplicity":
@@ -285,7 +291,8 @@ class FedCore:
             # shard_map must be typed device-varying over dp.
             init = _to_varying(init, "dp")
         (params, _), losses = jax.lax.scan(
-            step, init, jnp.arange(cfg.max_local_steps)
+            step, init, jnp.arange(cfg.max_local_steps),
+            unroll=min(cfg.step_unroll, cfg.max_local_steps),
         )
         mean_loss = jnp.where(
             steps_eff > 0,

@@ -16,6 +16,7 @@ sequences too long for one device's HBM.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -96,8 +97,6 @@ class TextTransformer(nn.Module):
         # shard_map with L sharded over the "sp" mesh axis: tokens is the
         # LOCAL chunk, positions are offset by the rank's chunk start, and
         # the mean-pool reduces over the global sequence via psum.
-        import jax
-
         ring = self.attention_impl == "ring"
         pad_mask = tokens != self.pad_id
         emb = nn.Embed(

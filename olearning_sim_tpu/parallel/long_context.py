@@ -32,7 +32,7 @@ def sp_forward(model, params, tokens, plan: MeshPlan):
     over ``tokens`` [B, L] with L sharded over the plan's ``sp`` axis and
     the batch over ``dp``. Returns logits [B, num_classes].
 
-    ``L`` must divide ``sp`` and ``B`` must divide ``dp`` (pad with the
+    ``sp`` must divide ``L`` and ``dp`` must divide ``B`` (pad with the
     model's pad_id / duplicate rows if not — padding tokens are masked out
     of attention and pooling by construction).
     """
@@ -94,13 +94,21 @@ def sp_evaluate(model, params, tokens, labels, plan: MeshPlan,
     import optax
 
     n = tokens.shape[0]
+    if n == 0 or (batch is not None and batch <= 0):
+        raise ValueError(
+            f"sp_evaluate needs a non-empty eval set and positive batch "
+            f"(n={n}, batch={batch})"
+        )
     batch = batch or n
-    # Pad the batch so every slice divides dp (padded rows weighted 0).
+    batch += (-batch) % plan.dp
+    # Pad the tail slice to the FULL batch (not just dp-divisibility): a
+    # distinct tail shape would retrace and recompile the whole sharded
+    # forward for one slice; padded rows are dropped via [:real] below.
     losses = accs = seen = 0.0
     for i in range(0, n, batch):
         tb, yb = tokens[i : i + batch], labels[i : i + batch]
         real = len(yb)
-        pad = (-real) % plan.dp
+        pad = batch - real
         if pad:
             tb = np.concatenate([tb, np.repeat(tb[-1:], pad, 0)])
             yb = np.concatenate([yb, np.repeat(yb[-1:], pad, 0)])

@@ -7,9 +7,19 @@ from olearning_sim_tpu.taskmgr.operator_flow import (
     OperatorFlowController,
     register_flow_strategy,
 )
+from olearning_sim_tpu.taskmgr.queue_repo import (
+    MemoryQueueRepo,
+    QueueRepo,
+    RedisQueueRepo,
+    SqliteQueueRepo,
+)
 
 __all__ = [
+    "MemoryQueueRepo",
     "OperatorFlowController",
+    "QueueRepo",
+    "RedisQueueRepo",
+    "SqliteQueueRepo",
     "TaskStatus",
     "calculate_conditions",
     "combine_task_status",

@@ -95,6 +95,7 @@ class TaskManager:
         cost_model=None,
         perf=None,
         logger: Optional[Logger] = None,
+        intake_queue=None,
     ):
         """``runner_factory(task_config, task_repo, deviceflow, stop_event)``
         builds the engine runner for a scheduled task; defaults to the
@@ -118,6 +119,10 @@ class TaskManager:
         from olearning_sim_tpu.taskmgr.hybrid import CostModel
 
         self._cost_model = cost_model if cost_model is not None else CostModel()
+        # Optional alternate intake (reference RedisRepo submit path,
+        # ``utils_redis.py:16-48``): a QueueRepo of task-JSON payloads
+        # drained by the schedule daemon through the normal submit path.
+        self._intake_queue = intake_queue
         # (task_id, data_name) -> staged device-shard path (hybrid split)
         self._device_paths: dict = {}
         self._lock = threading.RLock()
@@ -406,9 +411,45 @@ class TaskManager:
         )
 
     # ------------------------------------------------------------ scheduling
+    def drain_intake_once(self) -> int:
+        """Pop every pending task-JSON payload off the alternate intake
+        queue and submit it through the normal path (reference Redis-list
+        ``submitTask`` variant, ``task_manager.py:255-345``). Returns the
+        number of tasks accepted; malformed payloads are logged and dropped
+        (they would fail validation identically on every retry)."""
+        if self._intake_queue is None:
+            return 0
+        accepted = 0
+        while True:
+            payload = self._intake_queue.pop()
+            if payload is None:
+                return accepted
+            try:
+                tc = json2taskconfig(payload)
+            except Exception as e:  # noqa: BLE001 — bad payload must not kill the daemon
+                self.logger.error(
+                    task_id="", system_name="TaskMgr",
+                    module_name="drain_intake_once",
+                    message=f"undecodable intake payload dropped: {e}",
+                )
+                continue
+            if self.submit_task(tc):
+                accepted += 1
+            else:
+                # The payload is consumed either way (retrying would fail
+                # identically), but unlike the gRPC path no caller sees the
+                # False — so the rejection must leave a trace.
+                self.logger.error(
+                    task_id=tc.taskID.taskID, system_name="TaskMgr",
+                    module_name="drain_intake_once",
+                    message="intake payload rejected by submit_task "
+                            "(validation / duplicate / missing UNDONE row)",
+                )
+
     def schedule_once(self) -> Optional[str]:
         """One scheduler iteration (reference ``run`` thread body,
         ``task_manager.py:1053-1069``); returns the launched task id."""
+        self.drain_intake_once()
         with self._lock:
             queue = self._task_queue.get_task_queue()
         if not queue:

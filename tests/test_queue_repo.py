@@ -1,0 +1,73 @@
+"""Alternate task-intake queues (reference RedisRepo path,
+``utils_redis.py:16-48`` + the commented Redis ``submitTask`` variant)."""
+
+import json
+
+from olearning_sim_tpu.taskmgr.queue_repo import MemoryQueueRepo, SqliteQueueRepo
+from olearning_sim_tpu.taskmgr.status import TaskStatus
+from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+
+from tests.test_taskmgr import make_task_json
+
+
+def test_memory_queue_fifo():
+    q = MemoryQueueRepo()
+    assert q.pop() is None
+    q.push("a")
+    q.push("b")
+    assert q.peek_all() == ["a", "b"]
+    assert len(q) == 2
+    assert q.pop() == "a"
+    assert q.pop() == "b"
+    assert q.pop() is None
+
+
+def test_sqlite_queue_durable_fifo(tmp_path):
+    path = str(tmp_path / "intake.db")
+    q = SqliteQueueRepo(path)
+    for s in ("x", "y", "z"):
+        q.push(s)
+    assert q.pop() == "x"
+    q.close()
+    # A restarted manager drains what the dead process enqueued.
+    q2 = SqliteQueueRepo(path)
+    assert q2.peek_all() == ["y", "z"]
+    assert q2.pop() == "y"
+    assert q2.pop() == "z"
+    assert q2.pop() is None
+    q2.close()
+
+
+def test_manager_drains_intake_queue():
+    intake = MemoryQueueRepo()
+    mgr = TaskManager(intake_queue=intake)
+    intake.push(json.dumps(make_task_json(task_id="via_queue")))
+    intake.push("{not json")  # malformed payload must be dropped, not fatal
+    accepted = mgr.drain_intake_once()
+    assert accepted == 1
+    assert len(intake) == 0
+    assert mgr.get_task_status("via_queue") == TaskStatus.QUEUED
+    # schedule_once drains implicitly: a payload pushed after boot is picked
+    # up on the next scheduler tick without a direct gRPC submit.
+    intake.push(json.dumps(make_task_json(task_id="via_tick")))
+    mgr.schedule_once()
+    assert mgr.get_task_status("via_tick") in (
+        TaskStatus.QUEUED, TaskStatus.RUNNING, TaskStatus.SUCCEEDED,
+    )
+
+
+def test_build_session_wires_intake_queue(tmp_path):
+    """The deployment entry point must expose the intake path (an operator
+    boots via --config; pushed tasks must actually drain)."""
+    from olearning_sim_tpu.config import build_session
+
+    intake_path = str(tmp_path / "intake.db")
+    producer = SqliteQueueRepo(intake_path)
+    producer.push(json.dumps(make_task_json(task_id="via_file")))
+    producer.close()
+    session = build_session({
+        "session": {"services": ["taskmgr"], "address": "127.0.0.1:0"},
+        "repos": {"intake_queue_path": intake_path},
+    })
+    assert session.task_manager.drain_intake_once() == 1
+    assert session.task_manager.get_task_status("via_file") == TaskStatus.QUEUED
