@@ -48,11 +48,13 @@ BASELINE_ROUNDS_PER_SEC_PER_CHIP = 500.0 / 60.0 / V4_32_CHIPS
 
 def run_family(plan, *, name, model, algorithm, num_clients, n_local,
                input_shape=None, text=False, num_classes=10, batch=32,
-               local_steps=10, block=256, timed_rounds=3,
-               model_overrides=None, vocab_size=None, seq_len=None):
+               local_steps=10, block=256, timed_rounds=3, unroll=1,
+               block_unroll=1, model_overrides=None, vocab_size=None,
+               seq_len=None):
     """One benchmark family: build, warm, time. Returns the record dict."""
     cfg = FedCoreConfig(batch_size=batch, max_local_steps=local_steps,
-                        block_clients=block)
+                        block_clients=block, step_unroll=unroll,
+                        block_unroll=block_unroll)
     core = build_fedcore(model, algorithm, plan, cfg,
                          model_overrides=model_overrides,
                          input_shape=input_shape)
@@ -125,8 +127,8 @@ def main():
         plan, name="fedavg_cifar10_cnn4_10k", model="cnn4",
         algorithm=fedavg(0.05),
         **{**dict(num_clients=10_000, n_local=20, input_shape=(32, 32, 3),
-                  num_classes=10, batch=32, local_steps=10, block=256,
-                  timed_rounds=3), **shrink},
+                  num_classes=10, batch=32, local_steps=10, block=16,
+                  unroll=10, timed_rounds=3), **shrink},
     )
 
     # The headline line goes out BEFORE the breadth suite runs: a suite
@@ -166,8 +168,8 @@ def main():
              timed_rounds=2),
         dict(name="fedavg_cifar10_cnn4_1k", model="cnn4",
              algorithm=fedavg(0.05), num_clients=1000, n_local=20,
-             input_shape=(32, 32, 3), block=256, batch=32, local_steps=10,
-             timed_rounds=2),
+             input_shape=(32, 32, 3), block=16, unroll=10, batch=32,
+             local_steps=10, timed_rounds=2),
         dict(name="fedprox_femnist_resnet18_1k", model="resnet18",
              algorithm=fedprox(0.05, mu=0.01), num_clients=1000, n_local=16,
              input_shape=(28, 28, 1), num_classes=62, block=32,

@@ -108,9 +108,17 @@ class FedCoreConfig:
     # lax.scan unroll factor for the local-SGD step loop. Unrolling lets XLA
     # fuse/pipeline across sequential steps (the per-step tensors are small,
     # so scan's one-iteration window otherwise leaves the scalar units and
-    # DMA idle between convs). Measured on v5e at the headline config:
-    # unroll=5 with block_clients=64 lifted 0.45 -> 0.60 rounds/sec.
+    # DMA idle between convs). Measured on v5e at the headline config
+    # (10k clients, cnn4): block_clients/step_unroll 256/1 -> 0.42
+    # rounds/sec, 32/10 -> 0.69, 16/10 -> 0.72 — small blocks + full unroll
+    # let XLA pick a far better batched-kernel conv strategy than the big
+    # 256-group one. Sweep with scripts/profile_headline.py.
     step_unroll: int = 1
+    # Unroll factor for the outer scan over client blocks. Successive blocks
+    # are independent work (the carry is only an accumulator), so a small
+    # unroll lets XLA software-pipeline one block's epilogue against the
+    # next's prologue.
+    block_unroll: int = 1
 
     def use_multiplicity(self, n_local: int) -> bool:
         if self.sample_mode == "multiplicity":
@@ -437,7 +445,7 @@ class FedCore:
                 return (sum_delta, sum_w, sum_loss, count, sum_ploss), ys
 
             carry, (block_losses, new_vparams) = jax.lax.scan(
-                block_step, init, xs
+                block_step, init, xs, unroll=min(cfg.block_unroll, nb)
             )
             sum_delta, sum_w, sum_loss, count, sum_ploss = carry
             client_loss = block_losses.reshape((c_local,))

@@ -22,10 +22,10 @@ from olearning_sim_tpu.parallel.mesh import make_mesh_plan
 
 def time_config(plan, *, block, sample_mode="auto", num_clients=10_000,
                 n_local=20, batch=32, local_steps=10, rounds=3, unroll=1,
-                ds=None):
+                block_unroll=1, ds=None):
     cfg = FedCoreConfig(batch_size=batch, max_local_steps=local_steps,
                         block_clients=block, sample_mode=sample_mode,
-                        step_unroll=unroll)
+                        step_unroll=unroll, block_unroll=block_unroll)
     core = build_fedcore("cnn4", fedavg(0.05), plan, cfg)
     if ds is None:
         ds = make_synthetic_dataset(
@@ -47,6 +47,7 @@ def time_config(plan, *, block, sample_mode="auto", num_clients=10_000,
         times.append(time.perf_counter() - t0)
     return {
         "block": block, "sample_mode": sample_mode, "unroll": unroll,
+        "block_unroll": block_unroll,
         "round_s": round(float(np.mean(times)), 4),
         "rounds_per_sec": round(1.0 / float(np.mean(times)), 4),
         "compile_s": round(compile_s, 1),
@@ -109,12 +110,12 @@ def main():
 
     results = []
     sweeps = [
-        dict(block=128, unroll=2),
-        dict(block=128, unroll=5),
-        dict(block=64, unroll=5),
-        dict(block=64, unroll=10),
+        dict(block=16, unroll=10),            # shipped headline config
+        dict(block=16, unroll=10, block_unroll=2),
+        dict(block=16, unroll=10, block_unroll=4),
         dict(block=32, unroll=10),
-        dict(block=256, unroll=5),
+        dict(block=8, unroll=10),
+        dict(block=64, unroll=5),
     ]
     if args.quick:
         sweeps = sweeps[:2]

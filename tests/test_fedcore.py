@@ -151,3 +151,33 @@ def test_gather_and_multiplicity_modes_agree():
         lambda a, b: np.testing.assert_allclose(a, b, atol=1e-3, rtol=1e-3),
         pg, pm,
     )
+
+
+def test_unroll_knobs_do_not_change_results():
+    """step_unroll / block_unroll are pure scheduling knobs: the RNG streams
+    and arithmetic are identical, so the trajectory must match the rolled
+    program (same reduction order — exact equality modulo XLA fusion, so
+    assert tight allclose rather than bitwise)."""
+    results = {}
+    for tag, (su, bu) in {"rolled": (1, 1), "unrolled": (5, 2)}.items():
+        plan = make_mesh_plan(dp=8, mp=1)
+        cfg = FedCoreConfig(batch_size=8, max_local_steps=5, block_clients=2,
+                            step_unroll=su, block_unroll=bu)
+        core = build_fedcore(
+            "mlp2", fedavg(0.1), plan, cfg,
+            model_overrides={"hidden": (32,), "num_classes": NUM_CLASSES},
+            input_shape=INPUT_SHAPE,
+        )
+        ds = make_synthetic_dataset(
+            SEED, 32, 12, INPUT_SHAPE, NUM_CLASSES, class_sep=4.0
+        ).pad_for(plan, 2).place(plan)
+        state = core.init_state(jax.random.key(3))
+        for _ in range(2):
+            state, metrics = core.round_step(state, ds)
+        results[tag] = (jax.device_get(state.params), float(metrics.mean_loss))
+    (pr, lr), (pu, lu) = results["rolled"], results["unrolled"]
+    assert lr == pytest.approx(lu, rel=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6),
+        pr, pu,
+    )
