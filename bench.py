@@ -120,7 +120,7 @@ def main():
     plan = make_mesh_plan()
 
     shrink = dict(num_clients=512, n_local=8, batch=8, local_steps=2,
-                  block=32, timed_rounds=2) if on_cpu else {}
+                  block=32, unroll=1, timed_rounds=2) if on_cpu else {}
 
     # ------------------------------------------------------------ headline
     headline = run_family(
@@ -161,40 +161,119 @@ def main():
         return
 
     suite = [headline]
-    families = [
-        dict(name="fedavg_mnist_mlp_1k", model="mlp2",
-             algorithm=fedavg(0.05), num_clients=1000, n_local=20,
-             input_shape=(28, 28, 1), block=256, batch=32, local_steps=10,
-             timed_rounds=2),
-        dict(name="fedavg_cifar10_cnn4_1k", model="cnn4",
-             algorithm=fedavg(0.05), num_clients=1000, n_local=20,
-             input_shape=(32, 32, 3), block=16, unroll=10, batch=32,
-             local_steps=10, timed_rounds=2),
-        dict(name="fedprox_femnist_resnet18_1k", model="resnet18",
-             algorithm=fedprox(0.05, mu=0.01), num_clients=1000, n_local=16,
-             input_shape=(28, 28, 1), num_classes=62, block=32,
-             batch=16, local_steps=5, timed_rounds=2),
-        dict(name="fedadam_sent140_distilbert_1k", model="distilbert",
-             algorithm=fedadam(0.05), num_clients=1000, n_local=8, text=True,
-             seq_len=64, vocab_size=30522, num_classes=2,
-             input_shape=(64,), block=8, batch=16, local_steps=5,
-             timed_rounds=2),
-        dict(name="ditto_cifar100_vit_tiny_1k", model="vit_tiny",
-             algorithm=ditto(0.05, lam=0.1), num_clients=1000, n_local=16,
-             input_shape=(32, 32, 3), num_classes=100, block=16,
-             batch=16, local_steps=5, timed_rounds=2),
-    ]
     suite_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_suite.json"
     )
-    for fam in families:
+    # Isolation mode: on the axon relay platform each family runs in its own
+    # subprocess with a hard timeout (grants are serialized per-process, so a
+    # child can claim the device after the parent's programs finish, and a
+    # wedged compile only loses that family). On runtimes where a live parent
+    # owns the accelerator exclusively (plain TPU VM libtpu), subprocesses
+    # can never initialize — run in-process there. OLS_BENCH_ISOLATE=1/0
+    # overrides the autodetect.
+    isolate_env = os.environ.get("OLS_BENCH_ISOLATE", "auto")
+    if isolate_env == "auto":
+        isolate = os.environ.get("JAX_PLATFORMS", "").startswith("axon")
+    else:
+        isolate = isolate_env == "1"
+    for fam in SUITE_FAMILIES:
         try:
-            suite.append(run_family(plan, **fam))
+            record = (run_family_subprocess(fam) if isolate
+                      else run_one_inprocess(plan, fam))
         except Exception as e:  # noqa: BLE001 — one family must not kill the rest
-            suite.append({"family": fam["name"], "error": str(e)[:500]})
+            record = {"family": fam["name"], "error": str(e)[-500:]}
+        suite.append(record)
         with open(suite_path, "w") as f:
             json.dump(suite, f, indent=1)
 
 
+# Breadth suite (algorithms by name so a family can be reconstructed in a
+# child process). Each family runs in its OWN subprocess with a hard
+# timeout: a single family wedging the device tunnel mid-compile (observed
+# with resnet18's batched-kernel HLO) must not take down the whole suite.
+SUITE_FAMILIES = [
+    dict(name="fedavg_mnist_mlp_1k", model="mlp2",
+         algorithm=("fedavg", dict(local_lr=0.05)), num_clients=1000,
+         n_local=20, input_shape=(28, 28, 1), block=64, unroll=10, batch=32,
+         local_steps=10, timed_rounds=2),
+    dict(name="fedavg_cifar10_cnn4_1k", model="cnn4",
+         algorithm=("fedavg", dict(local_lr=0.05)), num_clients=1000,
+         n_local=20, input_shape=(32, 32, 3), block=16, unroll=10, batch=32,
+         local_steps=10, timed_rounds=2),
+    dict(name="fedprox_femnist_resnet18_1k", model="resnet18",
+         algorithm=("fedprox", dict(local_lr=0.05, mu=0.01)),
+         num_clients=1000, n_local=16, input_shape=(28, 28, 1),
+         num_classes=62, block=32, batch=16, local_steps=5, timed_rounds=2),
+    dict(name="fedadam_sent140_distilbert_1k", model="distilbert",
+         algorithm=("fedadam", dict(local_lr=0.05)), num_clients=1000,
+         n_local=8, text=True, seq_len=64, vocab_size=30522, num_classes=2,
+         input_shape=(64,), block=8, batch=16, local_steps=5,
+         timed_rounds=2),
+    dict(name="ditto_cifar100_vit_tiny_1k", model="vit_tiny",
+         algorithm=("ditto", dict(local_lr=0.05, lam=0.1)), num_clients=1000,
+         n_local=16, input_shape=(32, 32, 3), num_classes=100, block=16,
+         batch=16, local_steps=5, timed_rounds=2),
+]
+
+FAMILY_TIMEOUT_S = int(os.environ.get("OLS_BENCH_FAMILY_TIMEOUT", "900"))
+
+
+def make_algorithm(spec):
+    name, kw = spec
+    builders = {"fedavg": fedavg, "fedprox": fedprox, "fedadam": fedadam,
+                "ditto": ditto}
+    kw = dict(kw)
+    lr = kw.pop("local_lr")
+    return builders[name](lr, **kw)
+
+
+def run_family_subprocess(fam):
+    """Run one suite family in a child process with a hard timeout."""
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r", suffix=".json") as out:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--one", json.dumps(fam), "--out", out.name]
+        try:
+            proc = subprocess.run(
+                cmd, timeout=FAMILY_TIMEOUT_S, capture_output=True, text=True
+            )
+        except subprocess.TimeoutExpired as e:
+            # Keep the killed child's stderr — that's the wedge diagnostic
+            # this isolation exists to capture.
+            tail = (e.stderr or b"")
+            if isinstance(tail, bytes):
+                tail = tail.decode("utf-8", "replace")
+            return {"family": fam["name"],
+                    "error": f"timeout after {FAMILY_TIMEOUT_S}s",
+                    "stderr_tail": tail[-500:]}
+        body = out.read()
+    if proc.returncode != 0 or not body.strip():
+        return {"family": fam["name"],
+                "error": (proc.stderr or "no output")[-500:]}
+    return json.loads(body)
+
+
+def run_one_inprocess(plan, fam):
+    fam = dict(fam)
+    fam["algorithm"] = make_algorithm(fam["algorithm"])
+    return run_family(plan, **fam)
+
+
+def run_one(fam_json, out_path):
+    fam = json.loads(fam_json)
+    fam["algorithm"] = make_algorithm(tuple(fam["algorithm"]))
+    if fam.get("input_shape") is not None:
+        fam["input_shape"] = tuple(fam["input_shape"])
+    record = run_family(make_mesh_plan(), **fam)
+    with open(out_path, "w") as f:
+        json.dump(record, f)
+
+
 if __name__ == "__main__":
-    main()
+    if "--one" in sys.argv:
+        i = sys.argv.index("--one")
+        run_one(sys.argv[i + 1], sys.argv[sys.argv.index("--out") + 1])
+    else:
+        main()
