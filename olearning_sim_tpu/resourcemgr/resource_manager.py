@@ -97,7 +97,6 @@ class ResourceManager:
         minus frozen phone counts (reference ``getResource``,
         ``resource_manager.py:262-281``)."""
         with self._lock:
-            frozen = self._frozen_totals()
             phones = {u: dict(t) for u, t in self.phone_provider().items()}
             for task_phones in self._frozen_phones.values():
                 for user, types in task_phones.items():
@@ -105,20 +104,41 @@ class ResourceManager:
                         if user in phones and ptype in phones[user]:
                             phones[user][ptype] = max(0, phones[user][ptype] - n)
             return {
-                "logical_simulation": {
-                    "cpu": max(0.0, self.topology.cpu - frozen["cpu"]),
-                    "mem": max(0.0, self.topology.mem - frozen["mem"]),
-                },
+                "logical_simulation": self.get_cluster_available_resource(),
                 "device_simulation": phones,
                 "topology": dataclasses.asdict(self.topology),
             }
+
+    def get_cluster_available_resource(self) -> Dict[str, float]:
+        """Totals minus frozen ledger (reference
+        ``getClusterAvailableResource``, ``resource_manager.py:98-106``)."""
+        with self._lock:
+            frozen = self._frozen_totals()
+            return {
+                "cpu": max(0.0, self.topology.cpu - frozen["cpu"]),
+                "mem": max(0.0, self.topology.mem - frozen["mem"]),
+            }
+
+    def get_cluster_total_resource(self) -> Dict[str, float]:
+        """Boot-time topology totals (reference ``getClusterTotalResource``,
+        ``resource_manager.py:245-251``)."""
+        return {"cpu": self.topology.cpu, "mem": self.topology.mem}
+
+    def get_cluster_resource_detail(self) -> list:
+        """Frozen ledger rows (reference ``getClusterResourceDetail`` returns
+        the running rows, ``resource_manager.py:234-243``)."""
+        with self._lock:
+            return list(self.repo.query_all())
 
     # ---------------------------------------------------------------- freeze
     def request_cluster_resource(self, task_id: str, user_id: str,
                                  cpu: float, mem: float) -> bool:
         """Reference ``requestClusterResource`` (``resource_manager.py:135-194``)."""
         with self._lock:
-            avail = self.get_resource()["logical_simulation"]
+            # Only the cluster numbers are needed — get_resource() would also
+            # hit the phone provider (a gRPC round-trip in hybrid mode) under
+            # the ledger lock.
+            avail = self.get_cluster_available_resource()
             if cpu > avail["cpu"] or mem > avail["mem"]:
                 self.logger.error(
                     task_id=task_id, system_name="ResourceMgr", module_name="request",

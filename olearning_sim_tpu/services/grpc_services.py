@@ -73,6 +73,9 @@ class ResourceMgrServicer:
     SERVICE_NAME = "olearning_sim_tpu.services.ResourceMgr"
     METHODS = {
         "getResource": (empty_pb2.Empty, spb.ResourceSnapshot),
+        "getClusterAvailableResource": (empty_pb2.Empty, spb.ClusterResource),
+        "getClusterTotalResource": (empty_pb2.Empty, spb.ClusterResource),
+        "getClusterResourceDetail": (empty_pb2.Empty, spb.ClusterDetail),
         "requestClusterResource": (spb.ClusterResourceRequest, spb.Ack),
         "releaseClusterResource": (spb.TaskRef, spb.Ack),
         "requestPhoneResource": (spb.PhoneResourceRequest, spb.Ack),
@@ -91,6 +94,19 @@ class ResourceMgrServicer:
             ),
             device_simulation=_phones_to_proto(res.get("device_simulation", {})),
             topology_json=json.dumps(res.get("topology", {})),
+        )
+
+    def getClusterAvailableResource(self, request, context) -> spb.ClusterResource:
+        avail = self.manager.get_cluster_available_resource()
+        return spb.ClusterResource(cpu=avail["cpu"], mem=avail["mem"])
+
+    def getClusterTotalResource(self, request, context) -> spb.ClusterResource:
+        total = self.manager.get_cluster_total_resource()
+        return spb.ClusterResource(cpu=total["cpu"], mem=total["mem"])
+
+    def getClusterResourceDetail(self, request, context) -> spb.ClusterDetail:
+        return spb.ClusterDetail(
+            detail_json=json.dumps(self.manager.get_cluster_resource_detail())
         )
 
     def requestClusterResource(self, request, context) -> spb.Ack:
@@ -124,6 +140,18 @@ class ResourceMgrClient(_ClientBase):
             "device_simulation": _phones_from_proto(snap.device_simulation),
             "topology": json.loads(snap.topology_json or "{}"),
         }
+
+    def get_cluster_available_resource(self):
+        r = self._calls["getClusterAvailableResource"](empty_pb2.Empty())
+        return {"cpu": r.cpu, "mem": r.mem}
+
+    def get_cluster_total_resource(self):
+        r = self._calls["getClusterTotalResource"](empty_pb2.Empty())
+        return {"cpu": r.cpu, "mem": r.mem}
+
+    def get_cluster_resource_detail(self):
+        r = self._calls["getClusterResourceDetail"](empty_pb2.Empty())
+        return json.loads(r.detail_json or "[]")
 
     def request_cluster_resource(self, task_id, user_id, cpu, mem) -> bool:
         return self._calls["requestClusterResource"](spb.ClusterResourceRequest(

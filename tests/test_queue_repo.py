@@ -71,3 +71,41 @@ def test_build_session_wires_intake_queue(tmp_path):
     })
     assert session.task_manager.drain_intake_once() == 1
     assert session.task_manager.get_task_status("via_file") == TaskStatus.QUEUED
+
+
+class _FakeRedis:
+    """Minimal rpush/lpop/lrange/llen double (redis-py is not baked in)."""
+
+    def __init__(self):
+        self.lists = {}
+
+    def rpush(self, key, payload):
+        self.lists.setdefault(key, []).append(payload)
+
+    def lpop(self, key):
+        q = self.lists.get(key) or []
+        return q.pop(0) if q else None
+
+    def lrange(self, key, start, end):
+        q = self.lists.get(key, [])
+        end = len(q) if end == -1 else end + 1
+        return q[start:end]
+
+    def llen(self, key):
+        return len(self.lists.get(key, []))
+
+
+def test_redis_queue_adapter_wire_behavior():
+    """Reference rpush/lpop list semantics (``utils_redis.py:16-48``) via an
+    injected client."""
+    from olearning_sim_tpu.taskmgr.queue_repo import RedisQueueRepo
+
+    q = RedisQueueRepo(key="intake", client=_FakeRedis())
+    assert q.pop() is None
+    q.push("a")
+    q.push("b")
+    assert len(q) == 2
+    assert q.peek_all() == ["a", "b"]
+    assert q.pop() == "a"
+    assert q.pop() == "b"
+    assert q.pop() is None

@@ -139,3 +139,25 @@ def test_default_session_composition():
             assert res["logical_simulation"]["cpu"] > 0
     finally:
         sess.stop()
+
+
+def test_cluster_resource_query_rpcs(session, channel):
+    """Reference getClusterAvailable/Total/Detail RPCs
+    (``resource_manager.py:98-106,234-251``): total is the boot topology,
+    available shrinks by the frozen ledger, detail lists the frozen rows."""
+    rmc = ResourceMgrClient(channel)
+    assert rmc.get_cluster_total_resource() == {"cpu": 8.0, "mem": 8.0}
+    assert rmc.get_cluster_available_resource() == {"cpu": 8.0, "mem": 8.0}
+    assert rmc.get_cluster_resource_detail() == []
+
+    assert rmc.request_cluster_resource("trq", "user1", 3.0, 2.0)
+    avail = rmc.get_cluster_available_resource()
+    assert avail == {"cpu": 5.0, "mem": 6.0}
+    # total is unchanged by freezing
+    assert rmc.get_cluster_total_resource() == {"cpu": 8.0, "mem": 8.0}
+    detail = rmc.get_cluster_resource_detail()
+    assert [d["task_id"] for d in detail] == ["trq"]
+
+    assert rmc.release_cluster_resource("trq")
+    assert rmc.get_cluster_available_resource() == {"cpu": 8.0, "mem": 8.0}
+    assert rmc.get_cluster_resource_detail() == []
