@@ -3,8 +3,20 @@ from olearning_sim_tpu.engine.client_data import (
     make_synthetic_dataset,
     make_synthetic_text_dataset,
 )
-from olearning_sim_tpu.engine.algorithms import Algorithm, fedavg, fedprox, fedadam, ditto
+from olearning_sim_tpu.engine.algorithms import (
+    Algorithm,
+    ditto,
+    fedadagrad,
+    fedadam,
+    fedavg,
+    fedavgm,
+    fedprox,
+    fedyogi,
+    from_config,
+    scaffold,
+)
 from olearning_sim_tpu.engine.fedcore import (
+    ControlState,
     FedCore,
     PersonalState,
     RoundMetrics,
@@ -15,15 +27,21 @@ from olearning_sim_tpu.engine.fedcore import (
 __all__ = [
     "Algorithm",
     "ClientDataset",
+    "ControlState",
     "FedCore",
     "PersonalState",
     "RoundMetrics",
     "ServerState",
     "build_fedcore",
     "ditto",
-    "fedavg",
-    "fedprox",
+    "fedadagrad",
     "fedadam",
+    "fedavg",
+    "fedavgm",
+    "fedprox",
+    "fedyogi",
+    "from_config",
+    "scaffold",
     "make_synthetic_dataset",
     "make_synthetic_text_dataset",
 ]

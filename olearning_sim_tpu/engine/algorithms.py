@@ -30,6 +30,11 @@ class Algorithm:
     # Ditto personalization (BASELINE config 5)
     personalized: bool = False
     ditto_lambda: float = 0.0
+    # SCAFFOLD drift correction: per-client control variates c_i plus a
+    # server control c; local grads become g + c - c_i. Needs local_lr for
+    # the option-II c_i refresh ((x0 - x_K) / (K * lr)).
+    control_variates: bool = False
+    local_lr: float = 0.0
 
 
 def fedavg(local_lr: float = 0.05, server_lr: float = 1.0, server_momentum: float = 0.0) -> Algorithm:
@@ -55,6 +60,41 @@ def fedadam(
     return Algorithm("fedadam", optax.sgd(local_lr), optax.adam(server_lr, b1=b1, b2=b2, eps=eps))
 
 
+def fedyogi(
+    local_lr: float = 0.05,
+    server_lr: float = 1e-2,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    eps: float = 1e-3,
+) -> Algorithm:
+    """FedYogi (Reddi et al. 2021, same family as FedAdam): Yogi's additive
+    second-moment update is less aggressive than Adam's EMA when client
+    pseudo-gradients are sparse/bursty under churn."""
+    return Algorithm(
+        "fedyogi", optax.sgd(local_lr), optax.yogi(server_lr, b1=b1, b2=b2, eps=eps)
+    )
+
+
+def fedadagrad(
+    local_lr: float = 0.05, server_lr: float = 1e-2, eps: float = 1e-3
+) -> Algorithm:
+    """FedAdagrad (Reddi et al. 2021)."""
+    return Algorithm(
+        "fedadagrad",
+        optax.sgd(local_lr),
+        optax.adagrad(server_lr, initial_accumulator_value=0.0, eps=eps),
+    )
+
+
+def fedavgm(
+    local_lr: float = 0.05, server_lr: float = 1.0, server_momentum: float = 0.9
+) -> Algorithm:
+    """FedAvgM (Hsu et al. 2019): server momentum over round deltas."""
+    return Algorithm(
+        "fedavgm", optax.sgd(local_lr), optax.sgd(server_lr, momentum=server_momentum)
+    )
+
+
 def ditto(local_lr: float = 0.05, lam: float = 0.1, server_lr: float = 1.0) -> Algorithm:
     return Algorithm(
         "ditto",
@@ -65,11 +105,28 @@ def ditto(local_lr: float = 0.05, lam: float = 0.1, server_lr: float = 1.0) -> A
     )
 
 
+def scaffold(local_lr: float = 0.05, server_lr: float = 1.0) -> Algorithm:
+    """SCAFFOLD (Karimireddy et al. 2020): per-client control variates
+    correct client drift under non-IID data. Local steps use
+    ``g + c - c_i``; after training, ``c_i`` is refreshed by option II of
+    the paper and the server control ``c`` absorbs the weighted mean
+    correction. The per-client ``c_i`` live sharded over ``dp`` exactly
+    like Ditto's personal params (ControlState in fedcore)."""
+    return Algorithm(
+        "scaffold", optax.sgd(local_lr), optax.sgd(server_lr),
+        control_variates=True, local_lr=local_lr,
+    )
+
+
 _FACTORIES = {
     "fedavg": fedavg,
+    "fedavgm": fedavgm,
     "fedprox": fedprox,
     "fedadam": fedadam,
+    "fedyogi": fedyogi,
+    "fedadagrad": fedadagrad,
     "ditto": ditto,
+    "scaffold": scaffold,
 }
 
 

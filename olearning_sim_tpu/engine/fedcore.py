@@ -82,6 +82,15 @@ class PersonalState(struct.PyTreeNode):
     params: Any
 
 
+class ControlState(struct.PyTreeNode):
+    """SCAFFOLD control variates (Karimireddy et al. 2020): per-client
+    ``client_controls`` c_i [C, ...] sharded over ``dp`` (same memory plan
+    as Ditto's personal params) and the replicated server control c."""
+
+    client_controls: Any
+    server_control: Any
+
+
 @dataclasses.dataclass(frozen=True)
 class FedCoreConfig:
     batch_size: int = 32
@@ -175,6 +184,16 @@ class FedCore:
         self.plan = plan
         self.config = config
         self.param_specs = param_specs
+        if algorithm.personalized and algorithm.control_variates:
+            raise ValueError(
+                "personalized and control_variates are mutually exclusive "
+                "(both claim the per-client state slot)"
+            )
+        if algorithm.control_variates and algorithm.local_lr <= 0.0:
+            raise ValueError(
+                "control_variates needs algorithm.local_lr > 0 (the "
+                "option-II refresh divides by K * local_lr)"
+            )
         self._round_step = self._build_round_step()
         self._evaluate = self._build_evaluate()
         self._evaluate_personal = None  # built on first use
@@ -310,12 +329,18 @@ class FedCore:
         return params, mean_loss
 
     def _local_train(self, global_params, x, y, num_samples, num_steps, uid,
-                     base_key, round_idx):
+                     base_key, round_idx, server_c=None, ci=None):
         """One client's local training: masked lax.scan over SGD steps.
 
         Per-client RNG stream: fold_in(fold_in(base_key, uid), round) — stable
         under any resharding of clients to devices, which is what makes the
         accuracy-parity claim reproducible (SURVEY.md section 7 hard parts).
+
+        With SCAFFOLD control variates (``server_c``/``ci`` given): every
+        step's gradient is corrected by ``+ c - c_i``, and afterwards c_i
+        refreshes by option II of the paper: c_i' = c_i - c +
+        (x0 - x_K)/(K * lr) = c_i - c - delta/(K * lr). Returns an extra
+        ``dci = c_i' - c_i`` (zero when the client ran no steps).
         """
         alg = self.algorithm
         key = jax.random.fold_in(jax.random.fold_in(base_key, uid), round_idx)
@@ -331,13 +356,28 @@ class FedCore:
         if alg.prox_mu:
             penalty = lambda p: 0.5 * alg.prox_mu * _tree_l2_sq(p, global_params)
 
+        grad_transform = None
+        if ci is not None:
+            def grad_transform(grads, _params):
+                return jax.tree.map(
+                    lambda g, c, cc: g + c - cc, grads, server_c, ci
+                )
+
         params, mean_loss = self._masked_sgd(
             global_params, alg.local_optimizer.init(global_params),
             x, y, num_samples, steps_eff, key, persample, penalty_fn=penalty,
-            varying_init=True,
+            grad_transform=grad_transform, varying_init=True,
         )
         delta = jax.tree.map(jnp.subtract, params, global_params)
-        return delta, mean_loss
+        if ci is None:
+            return delta, mean_loss
+        k_lr = jnp.maximum(steps_eff, 1).astype(jnp.float32) * alg.local_lr
+        ran = steps_eff > 0
+        dci = jax.tree.map(
+            lambda c, d: jnp.where(ran, -c - d / k_lr, jnp.zeros_like(c)),
+            server_c, delta,
+        )
+        return delta, mean_loss, dci
 
     def _personal_train(self, vparams, global_params, x, y, num_samples,
                         num_steps, uid, active, base_key, round_idx):
@@ -388,9 +428,11 @@ class FedCore:
         alg = self.algorithm
         mesh = plan.mesh
         personalized = alg.personalized
+        controlled = alg.control_variates
 
         def shard_body(params, opt_state, round_idx, base_key,
-                       x, y, num_samples, num_steps, uid, weight, vparams):
+                       x, y, num_samples, num_steps, uid, weight, vparams,
+                       server_c):
             c_local = x.shape[0]
             if c_local % cfg.block_clients != 0:
                 raise ValueError(
@@ -405,24 +447,33 @@ class FedCore:
 
             xs = (blocked(x), blocked(y), blocked(num_samples),
                   blocked(num_steps), blocked(uid), blocked(weight),
-                  jax.tree.map(blocked, vparams) if personalized else None)
+                  jax.tree.map(blocked, vparams)
+                  if (personalized or controlled) else None)
 
             zero_delta = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params
             )
             init = (zero_delta, jnp.float32(0.0), jnp.float32(0.0),
-                    jnp.float32(0.0), jnp.float32(0.0))
+                    jnp.float32(0.0), jnp.float32(0.0),
+                    zero_delta if controlled else jnp.float32(0.0))
             # The carry accumulates device-varying values (per-shard client
             # sums), so its initial value must be typed as varying over dp.
             init = _to_varying(init, "dp")
 
             def block_step(carry, inp):
-                sum_delta, sum_w, sum_loss, count, sum_ploss = carry
+                sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc = carry
                 bx, by, bns, bst, buid, bw, bvp = inp
-                deltas, losses = jax.vmap(
-                    self._local_train,
-                    in_axes=(None, 0, 0, 0, 0, 0, None, None),
-                )(params, bx, by, bns, bst, buid, base_key, round_idx)
+                if controlled:
+                    deltas, losses, dcis = jax.vmap(
+                        self._local_train,
+                        in_axes=(None, 0, 0, 0, 0, 0, None, None, None, 0),
+                    )(params, bx, by, bns, bst, buid, base_key, round_idx,
+                      server_c, bvp)
+                else:
+                    deltas, losses = jax.vmap(
+                        self._local_train,
+                        in_axes=(None, 0, 0, 0, 0, 0, None, None),
+                    )(params, bx, by, bns, bst, buid, base_key, round_idx)
                 sum_delta = jax.tree.map(
                     lambda s, d: s + jnp.tensordot(bw, d.astype(jnp.float32), axes=(0, 0)),
                     sum_delta, deltas,
@@ -430,7 +481,23 @@ class FedCore:
                 sum_w = sum_w + bw.sum()
                 sum_loss = sum_loss + (bw * losses).sum()
                 count = count + (bw > 0).sum().astype(jnp.float32)
-                if personalized:
+                if controlled:
+                    # c_i advances only for participating clients; the server
+                    # control absorbs the weighted mean correction below.
+                    active = bw > 0
+
+                    def gate(d):
+                        return jnp.where(
+                            active.reshape((-1,) + (1,) * (d.ndim - 1)), d, 0.0
+                        )
+
+                    new_bvp = jax.tree.map(lambda v, d: v + gate(d), bvp, dcis)
+                    sum_dc = jax.tree.map(
+                        lambda s, d: s + jnp.tensordot(bw, d, axes=(0, 0)),
+                        sum_dc, dcis,
+                    )
+                    ys = (losses, new_bvp)
+                elif personalized:
                     new_vp, plosses = jax.vmap(
                         self._personal_train,
                         in_axes=(0, None, 0, 0, 0, 0, 0, 0, None, None),
@@ -442,14 +509,14 @@ class FedCore:
                     ys = (losses, new_vp)
                 else:
                     ys = (losses, None)
-                return (sum_delta, sum_w, sum_loss, count, sum_ploss), ys
+                return (sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc), ys
 
             carry, (block_losses, new_vparams) = jax.lax.scan(
                 block_step, init, xs, unroll=min(cfg.block_unroll, nb)
             )
-            sum_delta, sum_w, sum_loss, count, sum_ploss = carry
+            sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc = carry
             client_loss = block_losses.reshape((c_local,))
-            if personalized:
+            if personalized or controlled:
                 new_vparams = jax.tree.map(
                     lambda a: a.reshape((c_local,) + a.shape[2:]), new_vparams
                 )
@@ -473,6 +540,17 @@ class FedCore:
                 pseudo_grad, opt_state, params
             )
             new_params = optax.apply_updates(params, updates)
+            new_server_c = None
+            if controlled:
+                # c <- c + (|S|/N) * weighted-mean dc_i (SCAFFOLD eq. 5 with
+                # aggregation weights; N counts the padded population, which
+                # only shrinks the drift step by the padding fraction).
+                sum_dc = jax.lax.psum(sum_dc, "dp")
+                total = float(c_local * plan.dp)
+                frac = count / total
+                new_server_c = jax.tree.map(
+                    lambda c, s: c + frac * (s / denom), server_c, sum_dc
+                )
             metrics = RoundMetrics(
                 mean_loss=sum_loss / denom,
                 weight_sum=sum_w,
@@ -480,7 +558,8 @@ class FedCore:
                 client_loss=client_loss,
                 personal_loss=sum_ploss / denom,
             )
-            return new_params, new_opt_state, round_idx + 1, metrics, new_vparams
+            return (new_params, new_opt_state, round_idx + 1, metrics,
+                    new_vparams, new_server_c)
 
         rep = P()
         cl = P("dp")
@@ -489,28 +568,52 @@ class FedCore:
             personal_loss=rep,
         )
 
-        def make_shard_fn(vp_tree):
+        def make_shard_fn(vp_tree, sc_tree=None):
             vp_spec = jax.tree.map(lambda _: cl, vp_tree)
+            sc_spec = jax.tree.map(lambda _: rep, sc_tree)
             # Manual over dp only; mp is an AUTO axis — specs here describe
             # the dp placement, while the mp sharding of model tensors rides
             # in from param_specs and GSPMD inserts the TP collectives.
             return jax.shard_map(
                 shard_body,
                 mesh=mesh,
-                in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl, vp_spec),
-                out_specs=(rep, rep, rep, metrics_specs, vp_spec),
+                in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl,
+                          vp_spec, sc_spec),
+                out_specs=(rep, rep, rep, metrics_specs, vp_spec, sc_spec),
                 axis_names=frozenset({"dp"}),
             )
 
-        if personalized:
+        if controlled:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def round_step(state: ServerState, control: ControlState,
+                           x, y, num_samples, num_steps, uid, weight):
+                (new_params, new_opt_state, new_round, metrics, new_ci,
+                 new_sc) = make_shard_fn(
+                    control.client_controls, control.server_control
+                )(
+                    state.params, state.opt_state, state.round_idx,
+                    state.base_key, x, y, num_samples, num_steps, uid,
+                    weight, control.client_controls, control.server_control,
+                )
+                return (
+                    ServerState(
+                        params=new_params,
+                        opt_state=new_opt_state,
+                        round_idx=new_round,
+                        base_key=state.base_key,
+                    ),
+                    metrics,
+                    ControlState(client_controls=new_ci, server_control=new_sc),
+                )
+        elif personalized:
             @functools.partial(jax.jit, donate_argnums=(0, 1))
             def round_step(state: ServerState, personal: PersonalState,
                            x, y, num_samples, num_steps, uid, weight):
-                new_params, new_opt_state, new_round, metrics, new_vp = (
+                new_params, new_opt_state, new_round, metrics, new_vp, _ = (
                     make_shard_fn(personal.params)(
                         state.params, state.opt_state, state.round_idx,
                         state.base_key, x, y, num_samples, num_steps, uid,
-                        weight, personal.params,
+                        weight, personal.params, None,
                     )
                 )
                 return (
@@ -528,9 +631,9 @@ class FedCore:
 
             @functools.partial(jax.jit, donate_argnums=(0,))
             def round_step(state: ServerState, x, y, num_samples, num_steps, uid, weight):
-                new_params, new_opt_state, new_round, metrics, _ = shard_fn(
+                new_params, new_opt_state, new_round, metrics, _, _ = shard_fn(
                     state.params, state.opt_state, state.round_idx, state.base_key,
-                    x, y, num_samples, num_steps, uid, weight, None,
+                    x, y, num_samples, num_steps, uid, weight, None, None,
                 )
                 return (
                     ServerState(
@@ -544,32 +647,56 @@ class FedCore:
 
         return round_step
 
+    def _client_sharded_like(self, params):
+        """Shardings for a per-client tree [C, ...]: client axis over ``dp``,
+        tensor-parallel leaves additionally over ``mp`` per param_specs.
+        Shared by Ditto's personal params and SCAFFOLD's control variates."""
+        mesh = self.plan.mesh
+        if self.param_specs is None:
+            return jax.tree.map(
+                lambda _: NamedSharding(mesh, P("dp")), params
+            )
+        return jax.tree.map(
+            lambda _, s: NamedSharding(mesh, P("dp", *s)),
+            params, self.param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
     def init_personal(self, state: ServerState, num_clients: int) -> PersonalState:
         """Materialize Ditto personal params for ``num_clients`` (padded)
         clients: every client starts at the current global model, stored
         sharded over ``dp`` (and, for tensor-parallel leaves, additionally
         over ``mp``) in ``config.personal_dtype``."""
         dt = self.config.personal_dtype
-        mesh = self.plan.mesh
 
         def tile(p):
             target = p.astype(dt) if dt is not None else p
             return jnp.broadcast_to(target[None], (num_clients,) + p.shape)
 
-        if self.param_specs is None:
-            out = jax.tree.map(
-                lambda _: NamedSharding(mesh, P("dp")), state.params
-            )
-        else:
-            out = jax.tree.map(
-                lambda _, s: NamedSharding(mesh, P("dp", *s)),
-                state.params, self.param_specs,
-                is_leaf=lambda x: isinstance(x, P),
-            )
         tiled = jax.jit(
-            lambda params: jax.tree.map(tile, params), out_shardings=out
+            lambda params: jax.tree.map(tile, params),
+            out_shardings=self._client_sharded_like(state.params),
         )(state.params)
         return PersonalState(params=tiled)
+
+    def init_control(self, state: ServerState, num_clients: int) -> ControlState:
+        """Zero-initialized SCAFFOLD control variates: per-client c_i
+        [C, ...] sharded over ``dp`` (and ``mp`` for tensor-parallel
+        leaves), server c replicated."""
+        ci = jax.jit(
+            lambda params: jax.tree.map(
+                lambda p: jnp.zeros((num_clients,) + p.shape, jnp.float32),
+                params,
+            ),
+            out_shardings=self._client_sharded_like(state.params),
+        )(state.params)
+        sc = jax.jit(
+            lambda params: jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            ),
+            out_shardings=self.plan.replicated(),
+        )(state.params)
+        return ControlState(client_controls=ci, server_control=sc)
 
     def round_step(
         self,
@@ -578,6 +705,7 @@ class FedCore:
         participate: Optional[jax.Array] = None,
         num_steps: Optional[jax.Array] = None,
         personal: Optional[PersonalState] = None,
+        control: Optional[ControlState] = None,
     ):
         """Advance one FL round over the (placed, padded) population.
 
@@ -586,13 +714,31 @@ class FedCore:
         per-client local-step counts (hetero compute profiles); defaults to
         ``max_local_steps`` everywhere. ``personal`` — Ditto per-client state
         (required iff the algorithm is personalized); when given the return is
-        ``(state, metrics, personal)``.
+        ``(state, metrics, personal)``. ``control`` — SCAFFOLD control
+        variates (required iff the algorithm uses them); the return is then
+        ``(state, metrics, control)``.
         """
         weight = ds.weight if participate is None else ds.weight * participate
         if num_steps is None:
             num_steps = global_put(
                 np.full((ds.num_clients,), self.config.max_local_steps, np.int32),
                 self.plan.client_sharding(),
+            )
+        if self.algorithm.control_variates:
+            if control is None:
+                raise ValueError(
+                    f"algorithm {self.algorithm.name!r} uses control "
+                    f"variates; pass control=core.init_control(state, "
+                    f"ds.num_clients)"
+                )
+            return self._round_step(
+                state, control, ds.x, ds.y, ds.num_samples, num_steps,
+                ds.client_uid, weight,
+            )
+        if control is not None:
+            raise ValueError(
+                f"algorithm {self.algorithm.name!r} does not use control "
+                f"variates but control state was supplied"
             )
         if self.algorithm.personalized:
             if personal is None:

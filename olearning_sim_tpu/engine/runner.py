@@ -141,6 +141,8 @@ class SimulationRunner:
         self._round_outputs: Dict[str, Any] = {}
         # Ditto per-client personal state per population (personalized algos).
         self.personal_states: Dict[str, Any] = {}
+        # SCAFFOLD control variates per population (control-variate algos).
+        self.control_states: Dict[str, Any] = {}
         self.history: List[Dict[str, Any]] = []
 
         if not self.task_repo.has_task(task_id):
@@ -266,6 +268,15 @@ class SimulationRunner:
                 num_steps=num_steps,
             )
             self.personal_states[p.name] = personal
+        elif self.core.algorithm.control_variates:
+            control = self.control_states.get(p.name)
+            if control is None:
+                control = self.core.init_control(state, p.dataset.num_clients)
+            state, metrics, control = self.core.round_step(
+                state, p.dataset, participate=participate, control=control,
+                num_steps=num_steps,
+            )
+            self.control_states[p.name] = control
         else:
             state, metrics = self.core.round_step(
                 state, p.dataset, participate=participate, num_steps=num_steps
@@ -390,24 +401,41 @@ class SimulationRunner:
         )
         return last + 1
 
+    def _client_state_slot(self):
+        """The active per-client state dict and its initializer — Ditto
+        personal params or SCAFFOLD control variates (mutually exclusive).
+        Both ride the checkpoint's per-population tree slot so a resumed run
+        keeps its drift/personalization state instead of re-initializing."""
+        if self.core.algorithm.personalized:
+            return self.personal_states, self.core.init_personal
+        if self.core.algorithm.control_variates:
+            return self.control_states, self.core.init_control
+        return None, None
+
+    def _materialized_client_states(self):
+        slot, init = self._client_state_slot()
+        if slot is None:
+            return {}
+        for p in self.populations:
+            if p.name not in slot:
+                slot[p.name] = init(self.states[p.name], p.dataset.num_clients)
+        return slot
+
     def _try_resume(self) -> int:
         """Restore the latest round checkpoint if one exists; returns the
         round index to resume from (0 when starting fresh)."""
         if self.checkpointer is None:
             return 0
-        template_personal = dict(self.personal_states)
-        if self.core.algorithm.personalized:
-            for p in self.populations:
-                if p.name not in template_personal:
-                    template_personal[p.name] = self.core.init_personal(
-                        self.states[p.name], p.dataset.num_clients
-                    )
-        restored = self.checkpointer.restore(self.states, template_personal)
+        template_client = dict(self._materialized_client_states())
+        restored = self.checkpointer.restore(self.states, template_client)
         if restored is None:
             return 0
-        last_round, states, personal, history = restored
+        last_round, states, client_states, history = restored
         self.states = states
-        self.personal_states = personal
+        if self.core.algorithm.personalized:
+            self.personal_states = client_states
+        elif self.core.algorithm.control_variates:
+            self.control_states = client_states
         self.history = history
         self.logger.info(
             task_id=self.task_id, system_name="engine", module_name="runner",
@@ -420,17 +448,12 @@ class SimulationRunner:
             return
         if (round_idx + 1) % self.checkpoint_every and round_idx != self.rounds - 1:
             return
-        # Materialize personal state for every population before saving so the
-        # checkpoint's tree structure is deterministic (matches the restore
-        # template even when no train operator has run yet).
-        if self.core.algorithm.personalized:
-            for p in self.populations:
-                if p.name not in self.personal_states:
-                    self.personal_states[p.name] = self.core.init_personal(
-                        self.states[p.name], p.dataset.num_clients
-                    )
+        # Materialize per-client state for every population before saving so
+        # the checkpoint's tree structure is deterministic (matches the
+        # restore template even when no train operator has run yet).
         self.checkpointer.save(
-            round_idx, self.states, self.personal_states, self.history
+            round_idx, self.states, self._materialized_client_states(),
+            self.history
         )
 
     def operator_inputs(self, operator: OperatorSpec) -> Dict[str, Any]:

@@ -7,9 +7,12 @@ import pytest
 
 from olearning_sim_tpu.engine import (
     build_fedcore,
-    fedavg,
+    fedadagrad,
     fedadam,
+    fedavg,
+    fedavgm,
     fedprox,
+    fedyogi,
     make_synthetic_dataset,
 )
 from olearning_sim_tpu.engine.client_data import make_central_eval_set
@@ -38,7 +41,10 @@ def make_core(algorithm, num_clients=32, n_local=24, block=4, max_steps=5):
     return core, ds, plan
 
 
-@pytest.mark.parametrize("algorithm", [fedavg(0.1), fedprox(0.1, mu=0.05), fedadam(0.1)])
+@pytest.mark.parametrize("algorithm", [
+    fedavg(0.1), fedprox(0.1, mu=0.05), fedadam(0.1),
+    fedyogi(0.1), fedadagrad(0.1, server_lr=0.1), fedavgm(0.1),
+])
 def test_training_learns(algorithm):
     core, ds, _ = make_core(algorithm)
     state = core.init_state(jax.random.key(0))

@@ -1,0 +1,129 @@
+"""SCAFFOLD (Karimireddy et al. 2020) on the compiled engine: control
+variates live per-client sharded over dp, drift correction enters every
+local SGD step, option-II refresh updates c_i, and the server control
+aggregates over ICI."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.engine import (
+    ControlState,
+    build_fedcore,
+    fedavg,
+    make_synthetic_dataset,
+    scaffold,
+)
+from olearning_sim_tpu.engine.client_data import make_central_eval_set
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+INPUT_SHAPE = (16,)
+NUM_CLASSES = 4
+SEED = 11
+
+
+def build(algorithm, num_clients=32, n_local=24, alpha=None):
+    plan = make_mesh_plan(dp=8, mp=1)
+    cfg = FedCoreConfig(batch_size=8, max_local_steps=5, block_clients=4)
+    core = build_fedcore(
+        "mlp2", algorithm, plan, cfg,
+        model_overrides={"hidden": (32,), "num_classes": NUM_CLASSES},
+        input_shape=INPUT_SHAPE,
+    )
+    ds = make_synthetic_dataset(
+        SEED, num_clients, n_local, INPUT_SHAPE, NUM_CLASSES, class_sep=4.0,
+        dirichlet_alpha=alpha,
+    ).pad_for(plan, 4).place(plan)
+    return core, ds, plan
+
+
+def test_scaffold_trains_and_updates_controls():
+    core, ds, _ = build(scaffold(local_lr=0.1))
+    state = core.init_state(jax.random.key(0))
+    control = core.init_control(state, ds.num_clients)
+    # controls start at zero
+    assert all(
+        float(jnp.abs(leaf).max()) == 0.0
+        for leaf in jax.tree.leaves(control.client_controls)
+    )
+    losses = []
+    for _ in range(4):
+        state, metrics, control = core.round_step(state, ds, control=control)
+        losses.append(float(metrics.mean_loss))
+    assert losses[-1] < losses[0]
+    # after training, controls are non-zero (drift was measured)
+    assert any(
+        float(jnp.abs(leaf).max()) > 0.0
+        for leaf in jax.tree.leaves(control.client_controls)
+    )
+    assert any(
+        float(jnp.abs(leaf).max()) > 0.0
+        for leaf in jax.tree.leaves(control.server_control)
+    )
+
+
+def test_scaffold_requires_control_state():
+    core, ds, _ = build(scaffold(local_lr=0.1))
+    state = core.init_state(jax.random.key(0))
+    with pytest.raises(ValueError, match="control"):
+        core.round_step(state, ds)
+    # and plain fedavg must reject a control kwarg
+    core2, ds2, _ = build(fedavg(0.1))
+    state2 = core2.init_state(jax.random.key(0))
+    with pytest.raises(ValueError, match="control"):
+        core2.round_step(
+            state2, ds2,
+            control=ControlState(client_controls=None, server_control=None),
+        )
+
+
+def test_scaffold_nonparticipants_keep_controls():
+    core, ds, plan = build(scaffold(local_lr=0.1))
+    state = core.init_state(jax.random.key(0))
+    control = core.init_control(state, ds.num_clients)
+    # run one full round so controls become non-zero
+    state, _, control = core.round_step(state, ds, control=control)
+    before = jax.device_get(control.client_controls)
+    # second round: only the first half participates
+    mask = np.zeros(ds.num_clients, np.float32)
+    mask[: ds.num_clients // 2] = 1.0
+    from olearning_sim_tpu.parallel.mesh import global_put
+
+    participate = global_put(mask, plan.client_sharding())
+    state, _, control = core.round_step(
+        state, ds, participate=participate, control=control
+    )
+    after = jax.device_get(control.client_controls)
+    half = ds.num_clients // 2
+    for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        # non-participants frozen, at least one participant moved
+        np.testing.assert_array_equal(b[half:], a[half:])
+    assert any(
+        not np.array_equal(b[:half], a[:half])
+        for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after))
+    )
+
+
+def test_scaffold_beats_fedavg_under_drift():
+    """The whole point of SCAFFOLD: under pathological non-IID splits with
+    many local steps, drift correction reaches a better central accuracy
+    than plain FedAvg at the same budget."""
+    results = {}
+    for name, alg in (("fedavg", fedavg(0.1)), ("scaffold", scaffold(local_lr=0.1))):
+        core, ds, _ = build(alg, alpha=0.05)  # extreme label skew
+        state = core.init_state(jax.random.key(1))
+        control = (core.init_control(state, ds.num_clients)
+                   if alg.control_variates else None)
+        for _ in range(8):
+            if control is not None:
+                state, _, control = core.round_step(state, ds, control=control)
+            else:
+                state, _ = core.round_step(state, ds)
+        x, y = make_central_eval_set(SEED, 512, INPUT_SHAPE, NUM_CLASSES,
+                                     class_sep=4.0)
+        _, acc = core.evaluate(state.params, x, y)
+        results[name] = acc
+    # SCAFFOLD should not be (meaningfully) worse; typically better.
+    assert results["scaffold"] >= results["fedavg"] - 0.02, results
