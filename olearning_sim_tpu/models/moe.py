@@ -1,0 +1,204 @@
+"""Mixture-of-Experts text family: Switch-style top-1 routing with
+expert-parallel weights.
+
+The reference has no MoE (models live in user operator code — SURVEY.md
+section 2.6); this family is the rebuild's expert-parallelism (``ep``)
+scaling axis, alongside ``mp`` (tensor) and ``sp`` (sequence). The design
+is TPU-first throughout:
+
+- routing is realized with one-hot einsums (dispatch/combine tensors), not
+  scatters — everything lowers to MXU matmuls with static shapes;
+- per-expert FFN weights carry a leading expert axis ``[E, ...]``; under
+  expert parallelism they are annotated ``PartitionSpec("ep", ...)`` and
+  GSPMD inserts the token all-to-alls around the expert computation
+  (:mod:`olearning_sim_tpu.parallel.expert_parallel`);
+- capacity is static (``capacity_factor * tokens / E``); overflow tokens
+  fall through the residual connection (standard Switch behavior), so the
+  program has no data-dependent shapes.
+
+The Switch load-balancing auxiliary loss (num_experts * sum_e f_e * P_e) is
+sown into the ``intermediates`` collection as ``aux_loss``; training code
+adds it via ``mutable=["intermediates"]`` (see ``ep_train_step``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from olearning_sim_tpu.models.registry import ModelSpec, register_model
+
+
+class SwitchFFN(nn.Module):
+    """Top-1 (Switch) MoE feed-forward: route each token to one of
+    ``num_experts`` expert FFNs, weighted by the gate probability."""
+
+    num_experts: int
+    width: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, valid=None):
+        # x: [B, T, W]; valid: [B, T] bool, True = real token. Padding must
+        # stay out of routing — pads share one embedding, so they would all
+        # argmax to the same expert, eat its static capacity (evicting real
+        # tokens through the residual) and skew the load-balance statistics.
+        B, T, W = x.shape
+        E = self.num_experts
+        S = B * T
+        cap = max(1, int(self.capacity_factor * S / E))
+        xf = x.reshape(S, W)
+        vf = (jnp.ones((S,), bool) if valid is None
+              else valid.reshape(S))
+
+        # Router in f32 (gate logits are precision-sensitive).
+        logits = nn.Dense(E, dtype=jnp.float32, name="gate")(
+            xf.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)              # [S, E]
+        expert = jnp.argmax(probs, axis=-1)                  # [S]
+        gate_val = jnp.max(probs, axis=-1)                   # [S]
+
+        # Pads contribute no queue entry: their one-hot row is zeroed before
+        # the cumsum, so pos_in_expert is -1 for them.
+        onehot = (
+            jax.nn.one_hot(expert, E, dtype=jnp.int32)
+            * vf[:, None].astype(jnp.int32)
+        )                                                    # [S, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot            # [S, E], 1-based
+        pos_in_expert = pos.sum(axis=-1) - 1                 # [S], -1 for pads
+        keep = (pos_in_expert >= 0) & (pos_in_expert < cap)
+
+        # dispatch [S, E, C]: 1 where token s goes to (expert e, slot c);
+        # pads and over-capacity tokens ride the residual unchanged.
+        dispatch = (
+            jax.nn.one_hot(expert, E, dtype=self.dtype)[:, :, None]
+            * jax.nn.one_hot(pos_in_expert, cap, dtype=self.dtype)[:, None, :]
+            * keep[:, None, None].astype(self.dtype)
+        )
+        combine = dispatch * gate_val[:, None, None].astype(self.dtype)
+
+        # Gather tokens per expert: [E, C, W] — an einsum, not a scatter.
+        xe = jnp.einsum("sec,sd->ecd", dispatch, xf.astype(self.dtype))
+
+        # Per-expert FFN, leading expert axis sharded over ep.
+        w1 = self.param(
+            "expert_w1", nn.initializers.lecun_normal(), (E, W, self.mlp_dim),
+            jnp.float32,
+        )
+        b1 = self.param(
+            "expert_b1", nn.initializers.zeros, (E, 1, self.mlp_dim),
+            jnp.float32,
+        )
+        w2 = self.param(
+            "expert_w2", nn.initializers.lecun_normal(), (E, self.mlp_dim, W),
+            jnp.float32,
+        )
+        b2 = self.param(
+            "expert_b2", nn.initializers.zeros, (E, 1, W), jnp.float32
+        )
+        h = jax.nn.gelu(
+            jnp.einsum("ecd,edm->ecm", xe, w1.astype(self.dtype))
+            + b1.astype(self.dtype)
+        )
+        ye = (
+            jnp.einsum("ecm,emd->ecd", h, w2.astype(self.dtype))
+            + b2.astype(self.dtype)
+        )
+        # Un-dispatch, weighted by the gate.
+        y = jnp.einsum("sec,ecd->sd", combine, ye)
+
+        # Switch aux loss over REAL tokens only:
+        # E * sum_e (fraction routed to e) * (mean prob e).
+        n_valid = jnp.maximum(vf.sum().astype(jnp.float32), 1.0)
+        f = onehot.astype(jnp.float32).sum(axis=0) / n_valid  # [E]
+        p = (
+            probs * vf[:, None].astype(jnp.float32)
+        ).sum(axis=0) / n_valid                               # [E]
+        self.sow("intermediates", "aux_loss", E * jnp.sum(f * p))
+
+        return y.reshape(B, T, W).astype(x.dtype)
+
+
+class MoEBlock(nn.Module):
+    width: int
+    heads: int
+    mlp_dim: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        attn_mask = nn.make_attention_mask(pad_mask, pad_mask, dtype=self.dtype)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype, deterministic=True
+        )(x, x, mask=attn_mask)
+        x = nn.LayerNorm(dtype=self.dtype)(x + y)
+        y = SwitchFFN(
+            self.num_experts, self.width, self.mlp_dim,
+            self.capacity_factor, self.dtype,
+        )(x, valid=pad_mask)
+        return nn.LayerNorm(dtype=self.dtype)(x + y)
+
+
+class MoETextTransformer(nn.Module):
+    """Text classifier with Switch-MoE FFNs in every block (same tokenizer
+    conventions as the dense text family: int32 tokens, pad_id masked)."""
+
+    vocab_size: int = 30522
+    max_len: int = 128
+    width: int = 256
+    depth: int = 4
+    heads: int = 8
+    mlp_dim: int = 512
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    num_classes: int = 2
+    pad_id: int = 0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):
+        pad_mask = tokens != self.pad_id
+        emb = nn.Embed(
+            self.vocab_size, self.width,
+            embedding_init=nn.initializers.normal(stddev=0.02),
+            param_dtype=jnp.float32,
+        )(tokens)
+        pos = self.param(
+            "pos_embedding", nn.initializers.normal(stddev=0.02),
+            (1, self.max_len, self.width), jnp.float32,
+        )
+        L = tokens.shape[1]
+        x = (emb + pos[:, :L]).astype(self.dtype)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        for _ in range(self.depth):
+            x = MoEBlock(
+                self.width, self.heads, self.mlp_dim, self.num_experts,
+                self.capacity_factor, self.dtype,
+            )(x, pad_mask)
+        m = pad_mask[..., None].astype(jnp.float32)
+        s = (x.astype(jnp.float32) * m).sum(1)
+        c = m.sum(1)
+        pooled = s / jnp.maximum(c, 1.0)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(pooled)
+
+
+register_model(
+    ModelSpec(
+        name="moe_text",
+        builder=MoETextTransformer,
+        example_input_shape=(64,),
+        num_classes=2,
+        input_dtype=np.int32,
+        defaults={
+            "vocab_size": 30522, "max_len": 128, "width": 256, "depth": 4,
+            "heads": 8, "mlp_dim": 512, "num_experts": 8, "num_classes": 2,
+        },
+    )
+)

@@ -50,7 +50,7 @@ def get_model(name: str) -> ModelSpec:
     import importlib
     import importlib.util
 
-    for mod in ("mlp", "cnn", "resnet", "transformer", "vit"):
+    for mod in ("mlp", "cnn", "resnet", "transformer", "vit", "moe"):
         qual = f"olearning_sim_tpu.models.{mod}"
         # Only true absence is optional; a present-but-broken module raises.
         if importlib.util.find_spec(qual) is not None:

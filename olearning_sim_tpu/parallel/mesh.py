@@ -47,8 +47,13 @@ class MeshPlan:
         return self.mesh.shape.get("sp", 1)
 
     @property
+    def ep(self) -> int:
+        """Expert-parallel axis size (1 when absent)."""
+        return self.mesh.shape.get("ep", 1)
+
+    @property
     def n_devices(self) -> int:
-        return self.dp * self.mp * self.sp
+        return self.dp * self.mp * self.sp * self.ep
 
     def client_sharding(self) -> NamedSharding:
         """Arrays with a leading client axis: sharded over ``dp``."""
@@ -69,31 +74,44 @@ def make_mesh_plan(
     dp: Optional[int] = None,
     mp: int = 1,
     sp: int = 1,
+    ep: int = 1,
 ) -> MeshPlan:
-    """Build a ``(dp, mp[, sp])`` mesh over the given devices (default: all).
+    """Build a ``(dp, mp[, sp][, ep])`` mesh over the given devices
+    (default: all).
 
-    ``dp`` defaults to ``len(devices) // (mp * sp)``. Device order follows
-    ``jax.devices()`` which is already topology-sorted for ICI adjacency —
-    ``sp`` is the minor axis so ring-attention ppermute hops ride neighbor
-    links. The ``sp`` axis only exists when ``sp > 1`` (dp/mp plans keep
-    their two-axis mesh).
+    ``dp`` defaults to ``len(devices) // (mp * sp * ep)``. Device order
+    follows ``jax.devices()`` which is already topology-sorted for ICI
+    adjacency — ``sp``/``ep`` are minor axes so ring-attention ppermute
+    hops and MoE all-to-alls ride neighbor links. The ``sp``/``ep`` axes
+    only exist when their size > 1 (dp/mp plans keep their two-axis mesh).
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
-    if mp <= 0 or sp <= 0:
-        raise ValueError(f"mp and sp must be positive, got mp={mp} sp={sp}")
-    if dp is None:
-        dp = len(devices) // (mp * sp)
-    if dp * mp * sp > len(devices):
+    if mp <= 0 or sp <= 0 or ep <= 0:
         raise ValueError(
-            f"mesh {dp}x{mp}x{sp} needs {dp * mp * sp} devices, have {len(devices)}"
+            f"mp, sp and ep must be positive, got mp={mp} sp={sp} ep={ep}"
         )
-    if sp == 1:
-        grid = np.asarray(devices[: dp * mp]).reshape(dp, mp)
-        return MeshPlan(mesh=Mesh(grid, ("dp", "mp")))
-    grid = np.asarray(devices[: dp * mp * sp]).reshape(dp, mp, sp)
-    return MeshPlan(mesh=Mesh(grid, ("dp", "mp", "sp")))
+    if dp is None:
+        dp = len(devices) // (mp * sp * ep)
+    if dp <= 0:
+        raise ValueError(
+            f"dp={dp} (mp={mp} sp={sp} ep={ep} over {len(devices)} devices) "
+            f"— the mesh needs at least mp*sp*ep devices"
+        )
+    sizes = [("dp", dp), ("mp", mp)]
+    if sp > 1:
+        sizes.append(("sp", sp))
+    if ep > 1:
+        sizes.append(("ep", ep))
+    total = int(np.prod([s for _, s in sizes]))
+    if total > len(devices):
+        shape = "x".join(str(s) for _, s in sizes)
+        raise ValueError(
+            f"mesh {shape} needs {total} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[:total]).reshape([s for _, s in sizes])
+    return MeshPlan(mesh=Mesh(grid, tuple(n for n, _ in sizes)))
 
 
 def global_put(x, sharding: NamedSharding):
