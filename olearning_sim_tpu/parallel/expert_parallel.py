@@ -99,7 +99,9 @@ def ep_train_step(model, params, opt_state, tokens, labels, optimizer,
 def _compiled_step(model, plan: MeshPlan, optimizer, aux_weight: float):
     key = (model, plan.mesh, aux_weight)
     cached = _TRAIN_CACHE.get(key)
-    if cached is not None and cached[0] == id(optimizer):
+    # Strong reference + identity check (id() could match a recycled
+    # address after GC of the original optimizer).
+    if cached is not None and cached[0] is optimizer:
         return cached[1]
 
     def step(params, opt_state, tokens, labels):
@@ -124,5 +126,5 @@ def _compiled_step(model, plan: MeshPlan, optimizer, aux_weight: float):
         return optax.apply_updates(params, updates), new_opt, ce
 
     fn = jax.jit(step, donate_argnums=(0, 1))
-    _TRAIN_CACHE[key] = (id(optimizer), fn)
+    _TRAIN_CACHE[key] = (optimizer, fn)
     return fn

@@ -133,7 +133,9 @@ def _compiled_train(model, mesh, optimizer):
     # instead of silently growing an executable per call.
     key = (model, mesh)
     cached = _TRAIN_CACHE.get(key)
-    if cached is not None and cached[0] == id(optimizer):
+    # Strong reference + identity check (id() could match a recycled
+    # address after GC of the original optimizer).
+    if cached is not None and cached[0] is optimizer:
         return cached[1]
 
     import optax
@@ -173,7 +175,7 @@ def _compiled_train(model, mesh, optimizer):
         ),
         donate_argnums=(0, 1),
     )
-    _TRAIN_CACHE[key] = (id(optimizer), fn)
+    _TRAIN_CACHE[key] = (optimizer, fn)
     return fn
 
 

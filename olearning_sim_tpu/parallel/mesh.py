@@ -52,8 +52,13 @@ class MeshPlan:
         return self.mesh.shape.get("ep", 1)
 
     @property
+    def pp(self) -> int:
+        """Pipeline-parallel axis size (1 when absent)."""
+        return self.mesh.shape.get("pp", 1)
+
+    @property
     def n_devices(self) -> int:
-        return self.dp * self.mp * self.sp * self.ep
+        return self.dp * self.mp * self.sp * self.ep * self.pp
 
     def client_sharding(self) -> NamedSharding:
         """Arrays with a leading client axis: sharded over ``dp``."""
@@ -75,35 +80,40 @@ def make_mesh_plan(
     mp: int = 1,
     sp: int = 1,
     ep: int = 1,
+    pp: int = 1,
 ) -> MeshPlan:
-    """Build a ``(dp, mp[, sp][, ep])`` mesh over the given devices
+    """Build a ``(dp, mp[, sp][, ep][, pp])`` mesh over the given devices
     (default: all).
 
-    ``dp`` defaults to ``len(devices) // (mp * sp * ep)``. Device order
-    follows ``jax.devices()`` which is already topology-sorted for ICI
-    adjacency — ``sp``/``ep`` are minor axes so ring-attention ppermute
-    hops and MoE all-to-alls ride neighbor links. The ``sp``/``ep`` axes
-    only exist when their size > 1 (dp/mp plans keep their two-axis mesh).
+    ``dp`` defaults to ``len(devices) // (mp * sp * ep * pp)``. Device
+    order follows ``jax.devices()`` which is already topology-sorted for
+    ICI adjacency — ``sp``/``ep``/``pp`` are minor axes so ring-attention
+    and pipeline ppermute hops and MoE all-to-alls ride neighbor links.
+    These axes only exist when their size > 1 (dp/mp plans keep their
+    two-axis mesh).
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
-    if mp <= 0 or sp <= 0 or ep <= 0:
+    if mp <= 0 or sp <= 0 or ep <= 0 or pp <= 0:
         raise ValueError(
-            f"mp, sp and ep must be positive, got mp={mp} sp={sp} ep={ep}"
+            f"mp, sp, ep and pp must be positive, got mp={mp} sp={sp} "
+            f"ep={ep} pp={pp}"
         )
     if dp is None:
-        dp = len(devices) // (mp * sp * ep)
+        dp = len(devices) // (mp * sp * ep * pp)
     if dp <= 0:
         raise ValueError(
-            f"dp={dp} (mp={mp} sp={sp} ep={ep} over {len(devices)} devices) "
-            f"— the mesh needs at least mp*sp*ep devices"
+            f"dp={dp} (mp={mp} sp={sp} ep={ep} pp={pp} over {len(devices)} "
+            f"devices) — the mesh needs at least mp*sp*ep*pp devices"
         )
     sizes = [("dp", dp), ("mp", mp)]
     if sp > 1:
         sizes.append(("sp", sp))
     if ep > 1:
         sizes.append(("ep", ep))
+    if pp > 1:
+        sizes.append(("pp", pp))
     total = int(np.prod([s for _, s in sizes]))
     if total > len(devices):
         shape = "x".join(str(s) for _, s in sizes)
