@@ -97,6 +97,9 @@ class TextTransformer(nn.Module):
         # shard_map with L sharded over the "sp" mesh axis: tokens is the
         # LOCAL chunk, positions are offset by the rank's chunk start, and
         # the mean-pool reduces over the global sequence via psum.
+        # NOTE: parallel/pipeline.py mirrors this method's prologue/epilogue
+        # by param name — change both together (the pipeline dense-parity
+        # test fails if they drift).
         ring = self.attention_impl == "ring"
         pad_mask = tokens != self.pad_id
         emb = nn.Embed(

@@ -69,6 +69,25 @@ def unstack_block_params(rest: Any, stacked: Any) -> Any:
     return out
 
 
+def _validate_pp_inputs(model, plan: MeshPlan, caller: str) -> None:
+    if plan.pp <= 1:
+        raise ValueError(
+            f"{caller} needs a mesh with a pp axis (make_mesh_plan(pp=...))"
+        )
+    if model.depth % plan.pp:
+        raise ValueError(
+            f"pp={plan.pp} must divide the model depth {model.depth}"
+        )
+    impl = getattr(model, "attention_impl", "dense")
+    if impl != "dense":
+        # The stage blocks are hardcoded dense (flash/ring blocks have a
+        # different param layout); fail at the boundary, not inside scan.
+        raise ValueError(
+            f"pipeline parallelism requires attention_impl='dense', the "
+            f"model was built with {impl!r}"
+        )
+
+
 def _microbatch(tokens, num_microbatches: int):
     B = tokens.shape[0]
     if B % num_microbatches:
@@ -84,15 +103,11 @@ def pp_forward(model, params, tokens, plan: MeshPlan,
     """Forward the dense-attention text ``model`` with its blocks pipelined
     over the plan's ``pp`` axis. Returns logits [B, num_classes], matching
     the dense ``model.apply`` on one device."""
-    if plan.pp <= 1:
-        raise ValueError(
-            "pp_forward needs a mesh with a pp axis (make_mesh_plan(pp=...))"
-        )
-    depth = model.depth
-    if depth % plan.pp:
-        raise ValueError(f"pp={plan.pp} must divide the model depth {depth}")
+    _validate_pp_inputs(model, plan, "pp_forward")
     B = np.asarray(tokens).shape[0]
-    M = num_microbatches or plan.pp
+    M = num_microbatches if num_microbatches is not None else plan.pp
+    if M <= 0:
+        raise ValueError(f"num_microbatches must be positive, got {M}")
     if B % (plan.dp * M):
         raise ValueError(
             f"dp*num_microbatches = {plan.dp}*{M} must divide the batch {B} "
@@ -178,15 +193,10 @@ def pp_train_step(model, rest, stacked, opt_state, tokens, labels, optimizer,
     (model, mesh, microbatches)). Returns
     ``(rest, stacked, opt_state, loss)``.
     """
-    if plan.pp <= 1:
-        raise ValueError(
-            "pp_train_step needs a mesh with a pp axis (make_mesh_plan(pp=...))"
-        )
-    if model.depth % plan.pp:
-        raise ValueError(
-            f"pp={plan.pp} must divide the model depth {model.depth}"
-        )
-    M = num_microbatches or plan.pp
+    _validate_pp_inputs(model, plan, "pp_train_step")
+    M = num_microbatches if num_microbatches is not None else plan.pp
+    if M <= 0:
+        raise ValueError(f"num_microbatches must be positive, got {M}")
     B = np.asarray(tokens).shape[0]
     if B % (plan.dp * M):
         raise ValueError(
@@ -263,7 +273,14 @@ def _build_grads(model, mesh, M: int):
 
 class _PipelineGraph:
     """The pipelined logits computation, shared by forward and training
-    (identical graph; ``_build``'s body wraps it for inference)."""
+    (identical graph; ``_build``'s body wraps it for inference).
+
+    COUPLING NOTE: ``embed``/``head`` mirror TextTransformer.__call__'s
+    prologue/epilogue by flax auto-generated param name (Embed_0 /
+    pos_embedding / LayerNorm_0 / Dense_0) — restructuring the dense model
+    into setup()-style methods would rename every param and break existing
+    checkpoints, so the mirror is kept and
+    ``test_pp_forward_matches_dense`` enforces it stays in sync."""
 
     def __init__(self, model, mesh, M: int):
         self.model = model

@@ -125,17 +125,15 @@ def main():
         print(json.dumps(r), flush=True)
 
     if args.trace:
-        cfg = FedCoreConfig(batch_size=32, max_local_steps=10, block_clients=256)
+        # Trace the SHIPPED headline config (bench.py: block 16, unroll 10).
+        cfg = FedCoreConfig(batch_size=32, max_local_steps=10,
+                            block_clients=16, step_unroll=10)
         core = build_fedcore("cnn4", fedavg(0.05), plan, cfg)
-        ds = make_synthetic_dataset(
-            seed=0, num_clients=10_000, n_local=20,
-            input_shape=(32, 32, 3), num_classes=10,
-        ).pad_for(plan, 256).place(plan)
         state = core.init_state(jax.random.key(0))
-        state, m = core.round_step(state, ds)
+        state, m = core.round_step(state, shared_ds)
         float(m.mean_loss)
         with jax.profiler.trace("/tmp/headline_trace"):
-            state, m = core.round_step(state, ds)
+            state, m = core.round_step(state, shared_ds)
             float(m.mean_loss)
         print("trace written to /tmp/headline_trace")
 

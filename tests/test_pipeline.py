@@ -128,3 +128,16 @@ def test_pp_train_step_learns():
         )
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_pp_rejects_non_dense_attention_and_bad_microbatches():
+    dense, params, tokens = build()
+    plan = make_mesh_plan(dp=2, mp=1, pp=4)
+    spec = get_model("distilbert")
+    ring = spec.build(**OV, attention_impl="ring")
+    with pytest.raises(ValueError, match="dense"):
+        pp_forward(ring, params, tokens, plan)
+    with pytest.raises(ValueError, match="positive"):
+        pp_forward(dense, params, tokens, plan, num_microbatches=-1)
+    with pytest.raises(ValueError, match="positive"):
+        pp_forward(dense, params, tokens, plan, num_microbatches=0)
