@@ -69,7 +69,9 @@ def unstack_block_params(rest: Any, stacked: Any) -> Any:
     return out
 
 
-def _validate_pp_inputs(model, plan: MeshPlan, caller: str) -> None:
+def _validate_pp_inputs(model, plan: MeshPlan, caller: str, tokens,
+                        num_microbatches) -> int:
+    """Validate and return the resolved microbatch count M."""
     if plan.pp <= 1:
         raise ValueError(
             f"{caller} needs a mesh with a pp axis (make_mesh_plan(pp=...))"
@@ -80,12 +82,25 @@ def _validate_pp_inputs(model, plan: MeshPlan, caller: str) -> None:
         )
     impl = getattr(model, "attention_impl", "dense")
     if impl != "dense":
-        # The stage blocks are hardcoded dense (flash/ring blocks have a
-        # different param layout); fail at the boundary, not inside scan.
+        # The stage blocks apply dense attention. Ring params are
+        # layout-compatible, but the ring forward needs an sp axis inside
+        # shard_map (sharded sequence + psum pooling) which the pipeline
+        # graph doesn't provide; flash additionally has a different param
+        # layout. Fail at the boundary, not inside scan.
         raise ValueError(
             f"pipeline parallelism requires attention_impl='dense', the "
             f"model was built with {impl!r}"
         )
+    M = num_microbatches if num_microbatches is not None else plan.pp
+    if M <= 0:
+        raise ValueError(f"num_microbatches must be positive, got {M}")
+    B = np.asarray(tokens).shape[0]
+    if B % (plan.dp * M):
+        raise ValueError(
+            f"dp*num_microbatches = {plan.dp}*{M} must divide the batch {B} "
+            f"(microbatching applies to each dp shard's local batch)"
+        )
+    return M
 
 
 def _microbatch(tokens, num_microbatches: int):
@@ -103,16 +118,8 @@ def pp_forward(model, params, tokens, plan: MeshPlan,
     """Forward the dense-attention text ``model`` with its blocks pipelined
     over the plan's ``pp`` axis. Returns logits [B, num_classes], matching
     the dense ``model.apply`` on one device."""
-    _validate_pp_inputs(model, plan, "pp_forward")
-    B = np.asarray(tokens).shape[0]
-    M = num_microbatches if num_microbatches is not None else plan.pp
-    if M <= 0:
-        raise ValueError(f"num_microbatches must be positive, got {M}")
-    if B % (plan.dp * M):
-        raise ValueError(
-            f"dp*num_microbatches = {plan.dp}*{M} must divide the batch {B} "
-            f"(microbatching applies to each dp shard's local batch)"
-        )
+    M = _validate_pp_inputs(model, plan, "pp_forward", tokens,
+                            num_microbatches)
     if isinstance(params, tuple):
         # Pre-placed (rest, stacked) from pp_place_params — no host
         # round-trip of the block weights.
@@ -193,16 +200,8 @@ def pp_train_step(model, rest, stacked, opt_state, tokens, labels, optimizer,
     (model, mesh, microbatches)). Returns
     ``(rest, stacked, opt_state, loss)``.
     """
-    _validate_pp_inputs(model, plan, "pp_train_step")
-    M = num_microbatches if num_microbatches is not None else plan.pp
-    if M <= 0:
-        raise ValueError(f"num_microbatches must be positive, got {M}")
-    B = np.asarray(tokens).shape[0]
-    if B % (plan.dp * M):
-        raise ValueError(
-            f"dp*num_microbatches = {plan.dp}*{M} must divide the batch {B} "
-            f"(microbatching applies to each dp shard's local batch)"
-        )
+    M = _validate_pp_inputs(model, plan, "pp_train_step", tokens,
+                            num_microbatches)
     tokens = global_put(np.asarray(tokens), NamedSharding(plan.mesh, P("dp")))
     labels = global_put(np.asarray(labels), NamedSharding(plan.mesh, P("dp")))
 

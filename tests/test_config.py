@@ -127,3 +127,23 @@ def test_main_entry_point_serves_grpc(tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_env_overrides_sqlite_and_intake(tmp_path, monkeypatch):
+    """Deployment env overrides (k8s points state at the PVC even when the
+    mounted config file says otherwise)."""
+    cfg_state = tmp_path / "cfg_state.db"
+    env_state = tmp_path / "env_state.db"
+    intake = tmp_path / "intake.db"
+    monkeypatch.setenv("OLS_SQLITE_PATH", str(env_state))
+    monkeypatch.setenv("OLS_INTAKE_QUEUE_PATH", str(intake))
+    session = build_session({
+        "session": {"services": ["taskmgr"], "address": "127.0.0.1:0"},
+        "repos": {"sqlite_path": str(cfg_state)},
+    })
+    assert session.task_manager is not None
+    # env path wins: the config-file path is never created
+    assert env_state.exists()
+    assert not cfg_state.exists()
+    assert session.task_manager._intake_queue is not None
+    assert intake.exists()
