@@ -5,6 +5,17 @@ whole client population (the reference's per-phone subprocess loop,
 Runs anywhere jax runs; on a multi-device host the clients shard over dp.
 """
 
+# Pin the platform BEFORE any backend touch (sandboxes may pin an
+# accelerator via sitecustomize; demos should run anywhere). Set
+# OLS_EXAMPLE_PLATFORM=tpu (or "default" to keep the environment's choice).
+import os
+
+_plat = os.environ.get("OLS_EXAMPLE_PLATFORM", "cpu")
+if _plat != "default":
+    import jax
+
+    jax.config.update("jax_platforms", _plat)
+
 import os
 import sys
 

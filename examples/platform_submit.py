@@ -2,6 +2,17 @@
 reference-schema task JSON over gRPC, and poll it to completion (the
 reference's submitTask → schedule → run → getTaskStatus loop)."""
 
+# Pin the platform BEFORE any backend touch (sandboxes may pin an
+# accelerator via sitecustomize; demos should run anywhere). Set
+# OLS_EXAMPLE_PLATFORM=tpu (or "default" to keep the environment's choice).
+import os
+
+_plat = os.environ.get("OLS_EXAMPLE_PLATFORM", "cpu")
+if _plat != "default":
+    import jax
+
+    jax.config.update("jax_platforms", _plat)
+
 import json
 import os
 import sys

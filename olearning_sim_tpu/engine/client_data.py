@@ -132,7 +132,11 @@ def make_synthetic_dataset(
     """
     rng = np.random.default_rng(seed)
     feat_dim = int(np.prod(input_shape))
-    means = _class_means(seed, num_classes, feat_dim, class_sep)
+    # f32 up front: a f64 means table would make means[y] materialize a
+    # [C, n, F] float64 temp (5 GB at 10k clients) before the cast.
+    means = _class_means(seed, num_classes, feat_dim, class_sep).astype(
+        np.float32
+    )
 
     if dirichlet_alpha is None:
         probs = np.full((num_clients, num_classes), 1.0 / num_classes)
@@ -146,12 +150,15 @@ def make_synthetic_dataset(
         num_samples = rng.integers(lo, hi + 1, size=num_clients).astype(np.int32)
         num_samples = np.minimum(num_samples, n_local)
 
-    y = np.empty((num_clients, n_local), np.int32)
-    for c in range(num_clients):
-        y[c] = rng.choice(num_classes, size=n_local, p=probs[c])
+    # Vectorized categorical draw (inverse CDF): a per-client rng.choice
+    # loop costs seconds at 10k clients; this is one pass.
+    cum = probs.cumsum(axis=1)
+    u = rng.random((num_clients, n_local))
+    y = (u[..., None] > cum[:, None, :]).sum(axis=-1).astype(np.int32)
+    np.clip(y, 0, num_classes - 1, out=y)  # guard fp roundoff at the edge
     x = rng.standard_normal((num_clients, n_local, feat_dim), dtype=np.float32)
-    x += means[y].astype(np.float32)
-    x = x.astype(dtype).reshape(num_clients, n_local, *input_shape)
+    x += means[y]
+    x = x.astype(dtype, copy=False).reshape(num_clients, n_local, *input_shape)
 
     return ClientDataset(
         x=x,
