@@ -1,0 +1,15 @@
+"""Shared example preamble: pin the platform BEFORE any backend touch
+(sandboxes may pin an accelerator via sitecustomize; demos should run
+anywhere). Set OLS_EXAMPLE_PLATFORM=tpu to use an accelerator, or
+"default" to keep the environment's own backend choice."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+_plat = os.environ.get("OLS_EXAMPLE_PLATFORM", "cpu")
+if _plat != "default":
+    import jax
+
+    jax.config.update("jax_platforms", _plat)

@@ -5,23 +5,12 @@ whole client population (the reference's per-phone subprocess loop,
 Runs anywhere jax runs; on a multi-device host the clients shard over dp.
 """
 
-# Pin the platform BEFORE any backend touch (sandboxes may pin an
-# accelerator via sitecustomize; demos should run anywhere). Set
-# OLS_EXAMPLE_PLATFORM=tpu (or "default" to keep the environment's choice).
-import os
-
-_plat = os.environ.get("OLS_EXAMPLE_PLATFORM", "cpu")
-if _plat != "default":
-    import jax
-
-    jax.config.update("jax_platforms", _plat)
+import _bootstrap  # noqa: F401 — platform pin + repo path
 
 import os
 import sys
 
 import jax
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
 from olearning_sim_tpu.engine.client_data import make_central_eval_set

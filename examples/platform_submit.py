@@ -2,16 +2,7 @@
 reference-schema task JSON over gRPC, and poll it to completion (the
 reference's submitTask → schedule → run → getTaskStatus loop)."""
 
-# Pin the platform BEFORE any backend touch (sandboxes may pin an
-# accelerator via sitecustomize; demos should run anywhere). Set
-# OLS_EXAMPLE_PLATFORM=tpu (or "default" to keep the environment's choice).
-import os
-
-_plat = os.environ.get("OLS_EXAMPLE_PLATFORM", "cpu")
-if _plat != "default":
-    import jax
-
-    jax.config.update("jax_platforms", _plat)
+import _bootstrap  # noqa: F401 — platform pin + repo path
 
 import json
 import os
@@ -19,8 +10,6 @@ import sys
 import time
 
 import grpc
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 from olearning_sim_tpu.config import build_session
 from olearning_sim_tpu.taskmgr.codecs import json2taskconfig

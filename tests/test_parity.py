@@ -44,9 +44,12 @@ def np_softmax(z):
     return e / e.sum(axis=-1, keepdims=True)
 
 
-def np_local_train(params, x, y, n, uid, base_key, round_idx):
+def np_local_train(params, x, y, n, uid, base_key, round_idx,
+                   correction=None):
     """One client's local SGD, multiplicity-weighted exactly like the engine
-    (FedCoreConfig.sample_mode auto -> multiplicity at n_local<=2*batch)."""
+    (FedCoreConfig.sample_mode auto -> multiplicity at n_local<=2*batch).
+    ``correction`` (SCAFFOLD: c - c_i per param) is added to every step's
+    gradient."""
     p = {k: v.copy() for k, v in params.items()}
     key = jax.random.fold_in(jax.random.fold_in(base_key, uid), round_idx)
     for i in range(STEPS):
@@ -57,12 +60,13 @@ def np_local_train(params, x, y, n, uid, base_key, round_idx):
         sw /= BATCH
         h, logits = np_forward(p, x)
         g_logits = (np_softmax(logits) - np.eye(10, dtype=np.float32)[y]) * sw[:, None]
-        gw2 = h.T @ g_logits
-        gb2 = g_logits.sum(0)
         gh = (g_logits @ p["w2"].T) * (h > 0)
-        gw1 = x.T @ gh
-        gb1 = gh.sum(0)
-        for name, g in (("w1", gw1), ("b1", gb1), ("w2", gw2), ("b2", gb2)):
+        grads = {"w1": x.T @ gh, "b1": gh.sum(0),
+                 "w2": h.T @ g_logits, "b2": g_logits.sum(0)}
+        for name in p:
+            g = grads[name]
+            if correction is not None:
+                g = g + correction[name]
             p[name] = p[name] - LR * g
     return {k: p[k] - params[k] for k in params}
 
@@ -164,3 +168,105 @@ def test_oracle_learns(mnist_population):
     _, logits = np_forward(oracle, ex.reshape(len(ex), -1).astype(np.float32))
     acc = (logits.argmax(-1) == ey).mean()
     assert acc > 0.8, f"oracle failed to learn: acc={acc:.3f}"
+
+
+# ----------------------------------------------------------------- SCAFFOLD
+def np_local_train_scaffold(params, x, y, n, uid, base_key, round_idx, c, ci):
+    """Oracle SCAFFOLD local loop: every step's gradient corrected by
+    + c - c_i (shared SGD body); option-II refresh dci = -c - delta/(K*lr)."""
+    correction = {k: c[k] - ci[k] for k in params}
+    delta = np_local_train(params, x, y, n, uid, base_key, round_idx,
+                           correction=correction)
+    dci = {k: -c[k] - delta[k] / (STEPS * LR) for k in params}
+    return delta, dci
+
+
+def np_scaffold_round(params, ds, base_key, round_idx, c, cis,
+                      total_clients=None):
+    num = {k: np.zeros_like(v) for k, v in params.items()}
+    sum_dc = {k: np.zeros_like(v) for k, v in params.items()}
+    den = 0.0
+    count = 0
+    xs = np.asarray(ds.x, np.float32).reshape(ds.num_clients, N_LOCAL, -1)
+    ys = np.asarray(ds.y)
+    for cl in range(ds.num_clients):
+        w = float(ds.weight[cl])
+        if w <= 0:
+            continue
+        delta, dci = np_local_train_scaffold(
+            params, xs[cl], ys[cl], int(ds.num_samples[cl]),
+            int(ds.client_uid[cl]), base_key, round_idx, c, cis[cl],
+        )
+        for k in num:
+            num[k] += w * delta[k]
+            sum_dc[k] += w * dci[k]
+            cis[cl][k] = cis[cl][k] + dci[k]
+        den += w
+        count += 1
+    # The engine's N counts the PADDED population (fedcore docstring);
+    # mirror it so the server-control scale matches at any client count.
+    frac = count / (total_clients if total_clients else ds.num_clients)
+    new_params = {k: params[k] + num[k] / den for k in params}
+    new_c = {k: c[k] + frac * (sum_dc[k] / den) for k in params}
+    return new_params, new_c
+
+
+def test_scaffold_engine_matches_numpy_oracle(mnist_population):
+    """The SCAFFOLD implementation (drift-corrected steps, option-II control
+    refresh, weighted server-control update) agrees with an independent
+    NumPy implementation on identical RNG streams."""
+    from olearning_sim_tpu.engine import scaffold
+
+    ds_host, (ex, ey) = mnist_population
+    plan = make_mesh_plan(dp=8)
+    cfg = FedCoreConfig(batch_size=BATCH, max_local_steps=STEPS,
+                        block_clients=2, sample_mode="multiplicity")
+    core = build_fedcore(
+        "mlp2", scaffold(local_lr=LR), plan, cfg,
+        model_overrides={"hidden": [HIDDEN], "num_classes": 10},
+        input_shape=(28, 28, 1),
+    )
+    ds = ds_host.pad_for(plan, 2)
+    state = core.init_state(jax.random.key(0))
+    control = core.init_control(state, ds.num_clients)
+    base_key = jax.random.wrap_key_data(
+        np.asarray(jax.random.key_data(state.base_key))
+    )
+
+    p0 = jax.tree.map(np.asarray, state.params)
+    oracle = {
+        "w1": np.asarray(p0["Dense_0"]["kernel"], np.float32),
+        "b1": np.asarray(p0["Dense_0"]["bias"], np.float32),
+        "w2": np.asarray(p0["Dense_1"]["kernel"], np.float32),
+        "b2": np.asarray(p0["Dense_1"]["bias"], np.float32),
+    }
+    oc = {k: np.zeros_like(v) for k, v in oracle.items()}
+    ocis = [{k: np.zeros_like(v) for k, v in oracle.items()}
+            for _ in range(ds_host.num_clients)]
+
+    padded_n = ds.num_clients
+    ds = ds.place(plan, feature_dtype=None)
+    for r in range(ROUNDS):
+        state, metrics, control = core.round_step(state, ds, control=control)
+        oracle, oc = np_scaffold_round(oracle, ds_host, base_key, r, oc, ocis,
+                                       total_clients=padded_n)
+
+    _, acc_engine = core.evaluate(
+        state.params, ex.astype(np.float32).reshape(len(ex), 28, 28, 1), ey
+    )
+    _, logits = np_forward(oracle, ex.reshape(len(ex), -1).astype(np.float32))
+    acc_oracle = float((logits.argmax(-1) == ey).mean())
+    assert abs(float(acc_engine) - acc_oracle) <= 0.003, (
+        f"engine acc {float(acc_engine):.4f} vs oracle acc {acc_oracle:.4f}"
+    )
+
+    pe = jax.tree.map(np.asarray, state.params)
+    sc = jax.tree.map(np.asarray, control.server_control)
+    for got, want in (
+        (pe["Dense_0"]["kernel"], oracle["w1"]),
+        (pe["Dense_1"]["kernel"], oracle["w2"]),
+        (sc["Dense_0"]["kernel"], oc["w1"]),
+        (sc["Dense_1"]["kernel"], oc["w2"]),
+    ):
+        rel = np.linalg.norm(got - want) / max(np.linalg.norm(want), 1e-9)
+        assert rel < 0.03, f"relative divergence {rel:.4f}"
