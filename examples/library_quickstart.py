@@ -7,8 +7,6 @@ Runs anywhere jax runs; on a multi-device host the clients shard over dp.
 
 import _bootstrap  # noqa: F401 — platform pin + repo path
 
-import os
-import sys
 
 import jax
 

@@ -5,8 +5,6 @@ reference's submitTask → schedule → run → getTaskStatus loop)."""
 import _bootstrap  # noqa: F401 — platform pin + repo path
 
 import json
-import os
-import sys
 import time
 
 import grpc

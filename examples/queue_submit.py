@@ -6,7 +6,6 @@ import _bootstrap  # noqa: F401 — platform pin + repo path
 
 import json
 import os
-import sys
 import tempfile
 import time
 

@@ -1,4 +1,4 @@
-"""The five BASELINE benchmark configs, run end-to-end at tiny scale.
+"""The BASELINE benchmark configs (five families + scaffold), run end-to-end at tiny scale.
 
 Each config in configs/ is the full-scale task JSON; ``shrink`` scales the
 population/rounds/model down so the whole suite runs in CI on the 8-device
