@@ -108,3 +108,24 @@ def test_multiprocess_tensor_parallel_text():
     launcher = MultiHostLauncher(num_processes=2, coordinator_port=29434,
                                  devices_per_process=2)
     launcher.launch("olearning_sim_tpu.clustermgr.targets:smoke_tp_text")
+
+
+@pytest.mark.slow
+def test_multiprocess_ring_attention():
+    """sp ring hops across the process boundary (the DCN path for the
+    sequence axis)."""
+    launcher = MultiHostLauncher(num_processes=2, coordinator_port=29435,
+                                 devices_per_process=2)
+    res = launcher.launch("olearning_sim_tpu.clustermgr.targets:smoke_ring_sp")
+    assert all("smoke_ring_sp ok" in r.stdout for r in res)
+
+
+@pytest.mark.slow
+def test_multiprocess_pipeline():
+    """pp stage-to-stage ppermute across the process boundary."""
+    launcher = MultiHostLauncher(num_processes=2, coordinator_port=29436,
+                                 devices_per_process=2)
+    res = launcher.launch(
+        "olearning_sim_tpu.clustermgr.targets:smoke_pipeline_pp"
+    )
+    assert all("smoke_pipeline_pp ok" in r.stdout for r in res)
