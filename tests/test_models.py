@@ -163,3 +163,30 @@ def test_moe_round_step():
     state, metrics = core.round_step(state, ds)
     assert np.isfinite(float(metrics.mean_loss))
     assert int(metrics.clients_trained) == 16
+
+
+def test_moe_aux_loss_threaded_into_fl_path():
+    """build_fedcore detects the Switch router's sown aux loss and wires it
+    into per-client training (ADVICE r2: without this the gate trains with
+    zero balancing pressure federated); dense models get no aux plumbing."""
+    plan = make_mesh_plan()
+    cfg = FedCoreConfig(batch_size=2, max_local_steps=1, block_clients=2)
+    overrides = {"vocab_size": 97, "max_len": 16, "width": 16, "depth": 2,
+                 "heads": 2, "mlp_dim": 32, "num_experts": 4}
+    core = build_fedcore("moe_text", fedavg(0.05), plan, cfg,
+                         model_overrides=overrides, input_shape=(16,))
+    assert core.apply_aux_fn is not None
+    x = jnp.ones((3, 16), jnp.int32)
+    state = core.init_state(jax.random.key(0))
+    logits, aux = core.apply_aux_fn(state.params, x)
+    # Mean over the 2 blocks, so aux is O(1) regardless of depth (matches
+    # ep_train_step), and it must be differentiable wrt the gate kernel.
+    assert np.isfinite(float(aux)) and float(aux) > 0.5
+    g = jax.grad(lambda p: core.apply_aux_fn(p, x)[1])(state.params)
+    gate_g = [np.abs(np.asarray(v)).sum()
+              for k, v in jax.tree_util.tree_flatten_with_path(g)[0]
+              if "gate" in str(k)]
+    assert gate_g and max(gate_g) > 0.0
+
+    dense = build_fedcore("mlp2", fedavg(0.05), plan, cfg)
+    assert dense.apply_aux_fn is None

@@ -244,12 +244,14 @@ def test_scaffold_engine_matches_numpy_oracle(mnist_population):
     ocis = [{k: np.zeros_like(v) for k, v in oracle.items()}
             for _ in range(ds_host.num_clients)]
 
-    padded_n = ds.num_clients
+    # N in the server-control update is the TRUE population (the engine
+    # threads ds.num_real_clients in), so the oracle uses the same N and the
+    # trajectory is invariant to dp/block padding.
     ds = ds.place(plan, feature_dtype=None)
     for r in range(ROUNDS):
         state, metrics, control = core.round_step(state, ds, control=control)
         oracle, oc = np_scaffold_round(oracle, ds_host, base_key, r, oc, ocis,
-                                       total_clients=padded_n)
+                                       total_clients=ds_host.num_clients)
 
     _, acc_engine = core.evaluate(
         state.params, ex.astype(np.float32).reshape(len(ex), 28, 28, 1), ey

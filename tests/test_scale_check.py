@@ -1,0 +1,60 @@
+"""The sp/pp gradient-scale self-check (VERDICT r2 weak #3): the empirical
+check_vma=False inflation factor is measured at train-step build time and a
+mismatch fails fast instead of silently mis-scaling gradients."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from olearning_sim_tpu.parallel import scale_check
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+
+def test_measured_factor_matches_expected():
+    plan = make_mesh_plan(dp=2, pp=4)
+    got = scale_check.measured_factor(plan.mesh, ("dp", "pp"))
+    assert got == scale_check.expected_factor(plan.mesh, ("dp", "pp")) == 8
+    scale_check.verify_grad_scale(plan.mesh, ("dp", "pp"))  # no raise
+
+    plan_sp = make_mesh_plan(dp=4, sp=2)
+    assert scale_check.measured_factor(plan_sp.mesh, ("dp", "sp")) == 8
+    scale_check.verify_grad_scale(plan_sp.mesh, ("dp", "sp"))
+
+
+def test_factor_drift_fails_fast(monkeypatch):
+    """If a JAX change altered the transpose factor, the next train-step
+    build must raise, not train with wrong gradients. Simulated by
+    perturbing the expectation the measurement is compared against."""
+    plan = make_mesh_plan(dp=2, pp=2)
+    monkeypatch.setattr(scale_check, "_CHECKED", set())  # drop the cache
+    monkeypatch.setattr(
+        scale_check, "expected_factor", lambda mesh, axes: 3
+    )
+    with pytest.raises(RuntimeError, match="transpose factor changed"):
+        scale_check.verify_grad_scale(plan.mesh, ("dp", "pp"))
+
+
+def test_pp_train_step_runs_the_check(monkeypatch):
+    """The check is wired into the real pp train-step build path."""
+    from olearning_sim_tpu.models import get_model
+    from olearning_sim_tpu.parallel import pipeline
+    from olearning_sim_tpu.parallel.pipeline import pp_place_params, pp_train_step
+
+    plan = make_mesh_plan(dp=2, pp=2)
+    monkeypatch.setattr(scale_check, "_CHECKED", set())
+    monkeypatch.setattr(scale_check, "expected_factor", lambda mesh, axes: 3)
+    monkeypatch.setattr(pipeline, "_GRAD_CACHE", {})  # force a fresh build
+    spec = get_model("distilbert")
+    model = spec.build(vocab_size=64, max_len=8, width=16, depth=2, heads=2,
+                       mlp_dim=32, num_classes=2)
+    tok = np.asarray(
+        jax.random.randint(jax.random.key(0), (4, 8), 1, 64), np.int32
+    )
+    lab = np.asarray(tok[:, 0] % 2, np.int32)
+    params = model.init(jax.random.key(1), tok[:1])["params"]
+    rest, stacked = pp_place_params(params, plan)
+    opt = optax.sgd(0.1)
+    os = jax.jit(opt.init)((rest, stacked))
+    with pytest.raises(RuntimeError, match="transpose factor changed"):
+        pp_train_step(model, rest, stacked, os, tok, lab, opt, plan)

