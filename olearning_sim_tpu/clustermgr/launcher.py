@@ -106,10 +106,19 @@ class MultiHostLauncher:
             env = dict(os.environ)
             env.update(cfg.to_env())
             env["OLS_PLATFORM"] = self.platform
-            if self.platform == "cpu" and self.devices_per_process > 1:
+            if self.platform == "cpu":
+                # The launcher OWNS each worker's device count: an inherited
+                # --xla_force_host_platform_device_count (e.g. the test
+                # suite's 8-device mesh) would silently multiply the world.
+                import re
+
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\S+", "",
+                    env.get("XLA_FLAGS", ""),
+                ).strip()
                 env["XLA_FLAGS"] = (
-                    env.get("XLA_FLAGS", "")
-                    + f" --xla_force_host_platform_device_count={self.devices_per_process}"
+                    flags + f" --xla_force_host_platform_device_count="
+                    f"{self.devices_per_process}"
                 ).strip()
             if extra_env:
                 env.update(extra_env)

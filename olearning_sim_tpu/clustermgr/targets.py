@@ -242,3 +242,58 @@ def smoke_pipeline_pp() -> int:
     assert loss == loss, "NaN loss"
     print(f"smoke_pipeline_pp ok: world={n} pp={pp} matches dense, loss={loss:.4f}")
     return 0
+
+
+def elastic_segment() -> int:
+    """One elastic-training segment (see ``clustermgr/elastic.py``): join
+    the world at whatever size the launcher chose, restore the task
+    checkpoint, advance to ``OLS_ELASTIC_UNTIL`` rounds, checkpoint, exit.
+    The logical population is FIXED (independent of world size), so the
+    trajectory continues exactly across rescales."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+    from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+    ckdir = os.environ["OLS_ELASTIC_CKPT_DIR"]
+    until = int(os.environ["OLS_ELASTIC_UNTIL"])
+
+    n = jax.device_count()
+    plan = make_mesh_plan(devices=jax.devices(), dp=n, mp=1)
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (16,), "num_classes": 4},
+        input_shape=(12,),
+    )
+    # Population is a function of the TASK, not the world: 8 clients at any
+    # world size (pad_for re-pads per mesh; RNG streams fold in (uid, round)).
+    ds = make_synthetic_dataset(
+        seed=0, num_clients=8, n_local=4, input_shape=(12,), num_classes=4
+    ).pad_for(plan, cfg.block_clients).place(plan, feature_dtype=None)
+
+    cp = RoundCheckpointer(ckdir)
+    state = core.init_state(jax.random.key(0))
+    got = cp.restore({"d": state}, {})
+    history = []
+    if got is not None:
+        _, states, _, history = got
+        state = states["d"]
+        history = list(history)
+    start = int(jax.device_get(state.round_idx))
+    loss = float("nan")
+    for r in range(start, until):
+        state, metrics = core.round_step(state, ds)
+        loss = float(jax.device_get(metrics.mean_loss))
+        assert np.isfinite(loss), f"round {r}: non-finite loss"
+        history.append({"round": r, "loss": loss, "world": n})
+    cp.save(until - 1, {"d": state}, {}, history)
+    cp.wait()
+    cp.close()
+    print(f"elastic_segment ok: world={n} rounds {start}->{until} loss={loss:.4f}")
+    return 0

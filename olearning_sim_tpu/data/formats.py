@@ -117,6 +117,43 @@ def load_cifar_dir(d: str, split: str = "train", coarse: bool = False) -> Parsed
     return x.astype(np.float32) / 255.0, y, None
 
 
+def load_cifar_python_dir(d: str, split: str = "train", coarse: bool = False) -> Parsed:
+    """CIFAR-10/100 "python version" — the format of the actually-published
+    ``cifar-10-python.tar.gz`` / ``cifar-100-python.tar.gz`` archives: pickled
+    dicts with ``data`` (uint8 [N, 3072], channel-planar R/G/B row-major) and
+    ``labels`` / ``fine_labels``+``coarse_labels``. File names:
+    ``data_batch_1..5``/``test_batch`` (CIFAR-10) or ``train``/``test``
+    (CIFAR-100). Keys may be bytes (the published files are python-2
+    pickles). Unpickling is for trusted task archives only — the same trust
+    model as the reference's downloaded task data
+    (``utils_run_task.py:174-325``)."""
+    import pickle
+
+    names = sorted(os.listdir(d))
+    if any(n.startswith("data_batch") for n in names):
+        files = ([n for n in names if n.startswith("data_batch")]
+                 if split == "train" else ["test_batch"])
+        label_key = "labels"
+    else:
+        files = ["train"] if split == "train" else ["test"]
+        label_key = "coarse_labels" if coarse else "fine_labels"
+    missing = [n for n in files if n not in names]
+    if missing:
+        raise FileNotFoundError(f"CIFAR python files {missing} not in {d}")
+
+    def get(blob, key):
+        return blob[key.encode()] if key.encode() in blob else blob[key]
+
+    xs, ys = [], []
+    for n in files:
+        with open(os.path.join(d, n), "rb") as f:
+            blob = pickle.load(f, encoding="bytes")
+        xs.append(np.asarray(get(blob, "data"), np.uint8))
+        ys.append(np.asarray(get(blob, label_key), np.int32))
+    x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return x.astype(np.float32) / 255.0, np.concatenate(ys), None
+
+
 def hash_tokenize(text: str, vocab_size: int, seq_len: int) -> np.ndarray:
     """Deterministic hashed-token encoding (token 0 = padding). Stands in
     for the DistilBERT tokenizer without bundling vocab files; stable across
@@ -215,6 +252,10 @@ def detect_and_load(d: str, split: str = "train", **text_kwargs) -> Parsed:
         return load_mnist_dir(d, split)
     if any(n.endswith(".bin") for n in names):
         return load_cifar_dir(d, split)
+    if any(n.startswith("data_batch") for n in names) or (
+        "meta" in names and {"train", "test"} & set(names)
+    ):
+        return load_cifar_python_dir(d, split)
     ljson = [n for n in names if n.endswith(".json")]
     if ljson:
         tk = {k: v for k, v in text_kwargs.items() if k in ("vocab_size", "seq_len")}

@@ -39,13 +39,18 @@ def fetch_dataset_dir(
     """Materialize ``data_path`` as a local directory.
 
     - local directory -> itself
-    - local/remote ``.zip`` -> fetched (FileRepo for non-FILE transfer
-      types), extracted into a temp dir (zip-slip-guarded), nested-once
-      roots flattened by :func:`formats.detect_and_load`.
+    - local/remote ``.zip`` or ``.tar[.gz]`` -> fetched (FileRepo for
+      non-FILE transfer types), extracted into a temp dir (path-traversal
+      guarded), nested-once roots flattened by
+      :func:`formats.detect_and_load`. Tarballs matter because the genuine
+      published archives (``cifar-10-python.tar.gz`` etc.) are tars, not
+      zips — they ingest unchanged.
     """
+    import tarfile
+
     if os.path.isdir(data_path):
         return data_path
-    local_zip = data_path
+    local_arc = data_path
     is_remote = transfer_type is not None and getattr(transfer_type, "name", str(transfer_type)) not in ("FILE", "0")
     if is_remote or not os.path.exists(data_path):
         from olearning_sim_tpu.storage import FileTransferType, make_file_repo
@@ -53,19 +58,40 @@ def fetch_dataset_dir(
         tt = transfer_type if transfer_type is not None else FileTransferType.FILE
         repo = make_file_repo(FileTransferType(int(tt)) if isinstance(tt, int) else tt,
                               **(storage_settings or {}))
-        local_zip = os.path.join(tempfile.mkdtemp(prefix="olsdata_"), os.path.basename(data_path))
-        if not repo.download_file(data_path, local_zip):
+        local_arc = os.path.join(tempfile.mkdtemp(prefix="olsdata_"), os.path.basename(data_path))
+        if not repo.download_file(data_path, local_arc):
             raise FileNotFoundError(f"could not fetch dataset {data_path!r} via {tt}")
-    if not zipfile.is_zipfile(local_zip):
-        raise ValueError(f"dataset path {data_path!r} is neither a directory nor a zip archive")
-    out = tempfile.mkdtemp(prefix="olsdata_x_")
-    with zipfile.ZipFile(local_zip) as zf:
-        for m in zf.namelist():
-            target = os.path.realpath(os.path.join(out, m))
-            if not target.startswith(os.path.realpath(out) + os.sep):
-                raise ValueError(f"zip entry escapes extraction root: {m!r}")
-        zf.extractall(out)
-    return out
+    if zipfile.is_zipfile(local_arc):
+        out = tempfile.mkdtemp(prefix="olsdata_x_")
+        with zipfile.ZipFile(local_arc) as zf:
+            for m in zf.namelist():
+                target = os.path.realpath(os.path.join(out, m))
+                if not target.startswith(os.path.realpath(out) + os.sep):
+                    raise ValueError(f"zip entry escapes extraction root: {m!r}")
+            zf.extractall(out)
+        return out
+    if tarfile.is_tarfile(local_arc):
+        out = tempfile.mkdtemp(prefix="olsdata_x_")
+        with tarfile.open(local_arc) as tf:
+            try:
+                # filter="data" (py>=3.12) rejects absolute paths, ..
+                # traversal, links outside the root, and device/sticky bits.
+                tf.extractall(out, filter="data")
+            except TypeError:
+                # Older interpreters: the same traversal guard as the zip
+                # branch, by hand.
+                root = os.path.realpath(out)
+                for m in tf.getmembers():
+                    target = os.path.realpath(os.path.join(out, m.name))
+                    if not target.startswith(root + os.sep):
+                        raise ValueError(
+                            f"tar entry escapes extraction root: {m.name!r}"
+                        )
+                tf.extractall(out)
+        return out
+    raise ValueError(
+        f"dataset path {data_path!r} is neither a directory, a zip, nor a tar"
+    )
 
 
 def load_arrays(

@@ -182,6 +182,7 @@ class DeviceFlowServicer:
         "UnRegisterTask": (spb.TaskRef, spb.Ack),
         "NotifyStart": (spb.FlowNotifyRequest, spb.Ack),
         "NotifyComplete": (spb.FlowNotifyRequest, spb.Ack),
+        "PublishInbound": (spb.InboundMessage, spb.Ack),
         "GetTotalComputeResources": (spb.TaskRef, spb.FlowRegisterRequest),
         "CheckDeviceflowDispatchFinished": (spb.TaskRef, spb.Ack),
         "GetOutboundEndpoint": (empty_pb2.Empty, spb.OutboundEndpoint),
@@ -224,6 +225,20 @@ class DeviceFlowServicer:
             request.task_id, request.routing_key, request.compute_resource
         )
         return spb.Ack(is_success=ok, message=msg or "")
+
+    def PublishInbound(self, request, context) -> spb.Ack:
+        """Reference Pulsar inbound topic over gRPC: decode the JSON payload
+        and drop it into the service's inbound room."""
+        import json as _json
+
+        try:
+            payload = _json.loads(request.payload) if request.payload else None
+        except ValueError:
+            return spb.Ack(is_success=False, message="payload not json")
+        self.service.publish(
+            request.routing_key, request.compute_resource, payload
+        )
+        return spb.Ack(is_success=True)
 
     def GetTotalComputeResources(self, request, context) -> spb.FlowRegisterRequest:
         entry = self.service.registry.get(request.task_id) \
@@ -268,6 +283,18 @@ class DeviceFlowClient(_ClientBase):
             task_id=task_id, routing_key=routing_key,
             compute_resource=compute_resource))
         return ack.is_success, ack.message
+
+    def publish(self, routing_key, compute_resource, payload):
+        """Duck-type-compatible with DeviceFlowService.publish — a runner
+        wired to this client ships updates across processes (the reference's
+        Pulsar publish, message_producer.py analogue)."""
+        import json as _json
+
+        ack = self._calls["PublishInbound"](spb.InboundMessage(
+            routing_key=routing_key, compute_resource=compute_resource,
+            payload=_json.dumps(payload)))
+        if not ack.is_success:
+            raise IOError(f"PublishInbound rejected: {ack.message}")
 
     def check_dispatch_finished(self, task_id) -> bool:
         return self._calls["CheckDeviceflowDispatchFinished"](

@@ -1,0 +1,83 @@
+"""Live-world elastic rescale (VERDICT r2 missing #2): a running task's
+multi-process world grows 2 -> 4 workers mid-task via
+``ClusterManager.modify_slice`` and the task completes — checkpoint-restart
+elasticity (``clustermgr/elastic.py``), the TPU-native analogue of the
+reference's live KubeRay replica patch (``kuberay_cluster_manager.py:112-162``).
+
+Beyond completion, the rescaled trajectory must CONTINUE the same training:
+the final model equals an uninterrupted fixed-world run (FedCore's
+(uid, round) RNG streams make the round program resharding-stable)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.clustermgr.elastic import ElasticWorldRunner
+from olearning_sim_tpu.clustermgr.slice_manager import ClusterManager
+
+pytestmark = pytest.mark.slow
+
+
+def test_rescale_2_to_4_mid_task_completes_and_matches(tmp_path):
+    mgr = ClusterManager(devices=jax.devices())
+    assert len(mgr.devices) >= 4, "conftest provides the 8-device CPU mesh"
+    mgr.create_slice("elastic", 2, user_id="u1")
+    ckdir = str(tmp_path / "ckpt")
+
+    runner = ElasticWorldRunner(
+        mgr, "elastic", ckdir, segment_rounds=2, coordinator_port=29470,
+    )
+
+    def controller(segment_idx, completed_rounds):
+        # The rescale decision lands while the task is mid-flight (after
+        # segment 1 of 2): grow the slice 2 -> 4.
+        if segment_idx == 1:
+            runner.request_rescale(4)
+
+    history = runner.run(total_rounds=4, between_segments=controller)
+    assert history == [2, 4], history
+    assert mgr.query_slice("elastic")["num_devices"] == 4
+
+    # The completed task's checkpoint: 4 rounds done, loss history carries
+    # both world sizes.
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+    from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+    plan = make_mesh_plan(devices=jax.devices()[:1], dp=1, mp=1)
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (16,), "num_classes": 4},
+        input_shape=(12,),
+    )
+    cp = RoundCheckpointer(ckdir)
+    got = cp.restore({"d": core.init_state(jax.random.key(0))}, {})
+    assert got is not None
+    last_round, states, _, history_rec = got
+    cp.close()
+    assert last_round == 3
+    assert [h["world"] for h in history_rec] == [2, 2, 4, 4]
+    assert all(np.isfinite(h["loss"]) for h in history_rec)
+    final = jax.tree.map(np.asarray, states["d"].params)
+    assert int(states["d"].round_idx) == 4
+
+    # Uninterrupted single-process reference run: same task, fixed world.
+    ds = make_synthetic_dataset(
+        seed=0, num_clients=8, n_local=4, input_shape=(12,), num_classes=4
+    ).pad_for(plan, cfg.block_clients).place(plan, feature_dtype=None)
+    state = core.init_state(jax.random.key(0))
+    for _ in range(4):
+        state, _ = core.round_step(state, ds)
+    ref = jax.tree.map(np.asarray, state.params)
+    for (ka, a), (kb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(final)[0],
+        jax.tree_util.tree_flatten_with_path(ref)[0],
+    ):
+        np.testing.assert_allclose(
+            a, b, rtol=1e-5, atol=1e-6,
+            err_msg=f"elastic vs fixed-world mismatch at {jax.tree_util.keystr(ka)}",
+        )

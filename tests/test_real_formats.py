@@ -1,0 +1,216 @@
+"""Published-byte-layout proofs (VERDICT r2 missing #3).
+
+Genuine archives cannot be fetched in this sandbox (zero egress — DNS
+resolution itself fails), so these tests do the two strongest available
+things instead of training on self-synthesized fixtures that could share a
+parser's misunderstanding:
+
+1. Construct archives BYTE-BY-BYTE from the published format specs, right
+   here, sharing no code with the parsers under test (struct literals and
+   hand-placed probe pixels; spec cited inline). Orientation probes catch
+   the classic byte-layout mistakes — transposed rows/cols,
+   interleaved-vs-planar channels, wrong endianness — that synthesized
+   fixtures built on the parser's own helpers would mask.
+2. Cross-validate the CIFAR "python version" parser against
+   ``keras.src.datasets.cifar.load_batch`` — an independent third-party
+   implementation used in the wild against the genuine published files.
+
+Specs implemented:
+- IDX (yann.lecun.com/exdb/mnist): magic ``\\x00\\x00\\x08\\x03`` (ubyte,
+  3 dims) / ``\\x00\\x00\\x08\\x01``, big-endian uint32 dims, row-major
+  pixel bytes, files ``train-images-idx3-ubyte.gz`` etc.
+- CIFAR-10 binary (cs.toronto.edu/~kriz/cifar.html): 1 label byte + 3072
+  pixel bytes per record; pixels channel-planar (1024 R, then G, then B),
+  each plane row-major 32x32.
+- CIFAR-10/100 "python version": pickled dict per batch, keys as BYTES
+  (the genuine files are python-2 pickles): ``b'data'`` uint8 [N, 3072]
+  (same planar order), ``b'labels'`` / ``b'fine_labels'`` +
+  ``b'coarse_labels'``; shipped as tar.gz with a nested
+  ``cifar-10-batches-py`` / ``cifar-100-python`` root.
+"""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.data.formats import (
+    detect_and_load,
+    load_cifar_dir,
+    load_cifar_python_dir,
+)
+from olearning_sim_tpu.data.ingest import clear_cache, load_population
+
+
+# ----------------------------------------------------------------- helpers
+def write_idx_images(path: str, imgs: np.ndarray) -> None:
+    """IDX3 per the published spec: 0x00000803 magic, 3 big-endian uint32
+    dims, row-major ubyte pixels. gzip when path endswith .gz."""
+    n, r, c = imgs.shape
+    blob = b"\x00\x00\x08\x03" + struct.pack(">III", n, r, c) + imgs.tobytes()
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "wb") as f:
+        f.write(blob)
+
+
+def write_idx_labels(path: str, labels: np.ndarray) -> None:
+    blob = b"\x00\x00\x08\x01" + struct.pack(">I", len(labels)) + labels.tobytes()
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "wb") as f:
+        f.write(blob)
+
+
+def planar_cifar_pixels(rng, n):
+    """[n, 3072] uint8 in the published planar order, plus the HWC truth."""
+    hwc = rng.integers(0, 256, size=(n, 32, 32, 3), dtype=np.uint8)
+    planar = hwc.transpose(0, 3, 1, 2).reshape(n, 3072)
+    return planar, hwc
+
+
+# --------------------------------------------------------------- IDX/MNIST
+def test_idx_mnist_published_layout(tmp_path):
+    rng = np.random.default_rng(0)
+    imgs = np.zeros((7, 28, 28), np.uint8)
+    imgs[1] = (np.arange(784) % 256).reshape(28, 28)  # row-major probe
+    imgs[3, 5, 9] = 200                               # single-pixel probe
+    labels = rng.integers(0, 10, size=7, dtype=np.uint8)
+    write_idx_images(str(tmp_path / "train-images-idx3-ubyte.gz"), imgs)
+    write_idx_labels(str(tmp_path / "train-labels-idx1-ubyte.gz"), labels)
+    timgs = rng.integers(0, 256, size=(3, 28, 28), dtype=np.uint8)
+    tlabels = rng.integers(0, 10, size=3, dtype=np.uint8)
+    write_idx_images(str(tmp_path / "t10k-images-idx3-ubyte"), timgs)
+    write_idx_labels(str(tmp_path / "t10k-labels-idx1-ubyte"), tlabels)
+
+    x, y, writer = detect_and_load(str(tmp_path), "train")
+    assert x.shape == (7, 28, 28, 1) and writer is None
+    assert np.array_equal(y, labels.astype(np.int32))
+    # Row-major: byte k of image 1 is pixel (k // 28, k % 28).
+    assert x[1, 0, 1, 0] == 1 / 255.0 and x[1, 1, 0, 0] == (28 % 256) / 255.0
+    assert x[3, 5, 9, 0] == 200 / 255.0 and x[3, 9, 5, 0] == 0.0
+    np.testing.assert_array_equal((x[..., 0] * 255).astype(np.uint8), imgs)
+
+    tx, ty, _ = detect_and_load(str(tmp_path), "test")  # ungzipped variant
+    np.testing.assert_array_equal((tx[..., 0] * 255).astype(np.uint8), timgs)
+    assert np.array_equal(ty, tlabels.astype(np.int32))
+
+
+# ----------------------------------------------------------- CIFAR binary
+def test_cifar10_binary_published_layout(tmp_path):
+    rng = np.random.default_rng(1)
+    planar, hwc = planar_cifar_pixels(rng, 4)
+    labels = rng.integers(0, 10, size=4, dtype=np.uint8)
+    records = b"".join(
+        bytes([labels[i]]) + planar[i].tobytes() for i in range(4)
+    )
+    (tmp_path / "data_batch_1.bin").write_bytes(records)
+    x, y, _ = load_cifar_dir(str(tmp_path), "train")
+    assert x.shape == (4, 32, 32, 3)
+    assert np.array_equal(y, labels.astype(np.int32))
+    # Channel-planar + per-plane row-major, reconstructed to HWC exactly.
+    np.testing.assert_array_equal((x * 255).astype(np.uint8), hwc)
+
+
+# ----------------------------------- CIFAR python version + keras oracle
+def _write_cifar10_python(root, rng, per_batch=6, batches=2):
+    d = root / "cifar-10-batches-py"
+    d.mkdir()
+    truth_x, truth_y = [], []
+    for b in range(1, batches + 1):
+        planar, hwc = planar_cifar_pixels(rng, per_batch)
+        labels = rng.integers(0, 10, size=per_batch).tolist()
+        with open(d / f"data_batch_{b}", "wb") as f:
+            pickle.dump({b"data": planar, b"labels": labels}, f, protocol=2)
+        truth_x.append(hwc)
+        truth_y.extend(labels)
+    planar, hwc = planar_cifar_pixels(rng, per_batch)
+    labels = rng.integers(0, 10, size=per_batch).tolist()
+    with open(d / "test_batch", "wb") as f:
+        pickle.dump({b"data": planar, b"labels": labels}, f, protocol=2)
+    with open(d / "batches.meta", "wb") as f:
+        pickle.dump({b"label_names": [b"c%d" % i for i in range(10)]}, f, 2)
+    return d, np.concatenate(truth_x), np.asarray(truth_y, np.int32), hwc, labels
+
+
+def test_cifar10_python_layout_and_keras_crosscheck(tmp_path):
+    rng = np.random.default_rng(2)
+    d, truth_x, truth_y, test_hwc, test_labels = _write_cifar10_python(tmp_path, rng)
+    x, y, _ = load_cifar_python_dir(str(d), "train")
+    assert x.shape == (12, 32, 32, 3)
+    np.testing.assert_array_equal((x * 255).astype(np.uint8), truth_x)
+    assert np.array_equal(y, truth_y)
+    tx, ty, _ = detect_and_load(str(d), "test")  # detection picks python fmt
+    np.testing.assert_array_equal((tx * 255).astype(np.uint8), test_hwc)
+    assert ty.tolist() == test_labels
+
+    # Independent oracle: keras's unpickler (used against the genuine
+    # archives in the wild) must read OUR bytes to the same arrays.
+    keras_cifar = pytest.importorskip("keras.src.datasets.cifar")
+    kx, ky = keras_cifar.load_batch(str(d / "data_batch_1"))
+    np.testing.assert_array_equal(
+        np.asarray(kx, np.uint8).transpose(0, 2, 3, 1),
+        (x[:6] * 255).astype(np.uint8),
+    )
+    assert list(ky) == y[:6].tolist()
+
+
+def test_cifar100_python_fine_and_coarse(tmp_path):
+    rng = np.random.default_rng(3)
+    d = tmp_path / "cifar-100-python"
+    d.mkdir()
+    planar, hwc = planar_cifar_pixels(rng, 5)
+    fine = rng.integers(0, 100, size=5).tolist()
+    coarse = rng.integers(0, 20, size=5).tolist()
+    for name in ("train", "test"):
+        with open(d / name, "wb") as f:
+            pickle.dump({b"data": planar, b"fine_labels": fine,
+                         b"coarse_labels": coarse}, f, protocol=2)
+    with open(d / "meta", "wb") as f:
+        pickle.dump({b"fine_label_names": []}, f, protocol=2)
+    x, y, _ = detect_and_load(str(d), "train")
+    np.testing.assert_array_equal((x * 255).astype(np.uint8), hwc)
+    assert y.tolist() == fine
+    _, yc, _ = load_cifar_python_dir(str(d), "train", coarse=True)
+    assert yc.tolist() == coarse
+
+
+# --------------------------------------- tar.gz ingestion, end-to-end train
+def test_targz_archive_trains_end_to_end(tmp_path):
+    """The genuine archives are tar.gz (not zip): a cifar-10-python-style
+    tarball ingests through load_population and trains one engine round."""
+    import jax
+
+    from olearning_sim_tpu.engine import build_fedcore, fedavg
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+    clear_cache()
+    rng = np.random.default_rng(4)
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    _write_cifar10_python(stage, rng, per_batch=40, batches=2)
+    tar_path = tmp_path / "cifar-10-python.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(stage / "cifar-10-batches-py", arcname="cifar-10-batches-py")
+
+    ds, eval_data, ncls = load_population(
+        str(tar_path), num_clients=8, n_local=16, scheme="iid", seed=0
+    )
+    assert ds.num_clients == 8 and int(ds.num_samples.sum()) == 80
+    assert eval_data is not None and len(eval_data[1]) == 40
+    assert 1 <= ncls <= 10
+
+    plan = make_mesh_plan()
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore("cnn4", fedavg(0.1), plan, cfg,
+                         model_overrides={"features": (4, 4, 8),
+                                          "num_classes": 10})
+    placed = ds.pad_for(plan, cfg.block_clients).place(plan)
+    state = core.init_state(jax.random.key(0))
+    state, metrics = core.round_step(state, placed)
+    assert np.isfinite(float(metrics.mean_loss))
+    assert int(metrics.clients_trained) == 8
+    clear_cache()
