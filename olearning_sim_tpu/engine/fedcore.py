@@ -131,6 +131,15 @@ class FedCoreConfig:
     # Weight on a model-sown auxiliary loss (Switch-MoE load balancing);
     # only consumed when the model sows one (build_fedcore detects it).
     aux_loss_weight: float = 0.01
+    # Dtype for the local-SGD scan carry (per-client params while stepping).
+    # None = keep the global param dtype (f32). jnp.bfloat16 halves the
+    # carry bytes the step loop reads/writes each iteration AND removes the
+    # f32->bf16 cast in front of every conv/matmul (models compute bf16
+    # anyway); the per-round delta is then quantized to bf16 steps. Changes
+    # numerics — gate on the accuracy-parity oracle
+    # (tests/test_parity_cnn.py::test_bf16_carry_parity) before shipping a
+    # measured config with it.
+    carry_dtype: Any = None
 
     def __post_init__(self):
         # scan(unroll=0) and zero-length loops fail at trace time with
@@ -320,6 +329,12 @@ class FedCore:
             loss, grads = jax.value_and_grad(loss_fn)(params)
             if grad_transform is not None:
                 grads = grad_transform(grads, params)
+                # Transforms mixing in f32 state (SCAFFOLD controls, Ditto
+                # pull) promote grads to f32; a bf16 carry must get bf16
+                # updates back or the scan carry changes dtype mid-loop.
+                grads = jax.tree.map(
+                    lambda g, p: g.astype(p.dtype), grads, params
+                )
             updates, new_opt = alg.local_optimizer.update(grads, opt_state, params)
             active = i < steps_eff
             if stateless_opt:
@@ -338,6 +353,13 @@ class FedCore:
                 )
             return carry, jnp.where(active, loss, 0.0)
 
+        orig_dtypes = jax.tree.map(lambda p: p.dtype, params0)
+        if cfg.carry_dtype is not None:
+            cast = lambda t: jax.tree.map(
+                lambda p: p.astype(cfg.carry_dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, t
+            )
+            params0, opt_state0 = cast(params0), cast(opt_state0)
         init = (params0, opt_state0)
         if varying_init:
             # Replicated initial carry accumulating shard-local data inside
@@ -347,6 +369,10 @@ class FedCore:
             step, init, jnp.arange(cfg.max_local_steps),
             unroll=min(cfg.step_unroll, cfg.max_local_steps),
         )
+        if cfg.carry_dtype is not None:
+            params = jax.tree.map(
+                lambda p, d: p.astype(d), params, orig_dtypes
+            )
         mean_loss = jnp.where(
             steps_eff > 0,
             losses.sum() / jnp.maximum(steps_eff, 1).astype(jnp.float32),
