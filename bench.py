@@ -184,7 +184,12 @@ def select_backend():
     """
     if os.environ.get("OLS_BENCH_NO_PROBE") == "1":
         return jax.default_backend(), False
-    backend = probe_backend(dict(os.environ))
+    # Mirror an explicit JAX_PLATFORMS into the child's forced platform: a
+    # sitecustomize that overrides the env var would otherwise send a
+    # user's JAX_PLATFORMS=cpu probe to the (possibly wedged) hardware.
+    backend = probe_backend(
+        dict(os.environ), platform=os.environ.get("JAX_PLATFORMS") or None
+    )
     if backend is not None:
         return backend, False
     # Default path dead (wedged/unavailable accelerator): probe cpu with a

@@ -9,7 +9,9 @@ to ``PARITY_convergence.json`` at the repo root.
 ``tests/test_parity_cnn.py::test_convergence_artifact_within_baseline_bound``
 enforces the committed artifact's bound in CI.
 
-Run (CPU is fine, ~10-20 min):
+Run (CPU is fine; budget ~2 h for the default 45 rounds on a loaded box —
+the artifact is rewritten after every eval, so an interrupt still leaves a
+valid record at the last evaluated round):
     JAX_PLATFORMS=cpu python scripts/convergence_parity.py
 """
 
@@ -34,8 +36,11 @@ if os.environ.get("JAX_PLATFORMS"):
 import numpy as np
 
 import cnn_oracle as oracle
-from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
-from olearning_sim_tpu.engine.client_data import make_central_eval_set
+from olearning_sim_tpu.engine import build_fedcore, fedavg
+from olearning_sim_tpu.engine.client_data import (
+    make_synthetic_texture_dataset,
+    make_texture_eval_set,
+)
 from olearning_sim_tpu.engine.fedcore import FedCoreConfig
 from olearning_sim_tpu.parallel.mesh import make_mesh_plan
 
@@ -44,7 +49,8 @@ COHORT = 64
 N_LOCAL = 20
 BATCH = 32
 STEPS = 10
-LR = 0.05
+LR = 0.1
+SEP = 1.0
 ROUNDS = int(os.environ.get("OLS_PARITY_ROUNDS", "45"))
 NCLS = 10
 SEED = 5
@@ -57,11 +63,15 @@ def main():
     cfg = FedCoreConfig(batch_size=BATCH, max_local_steps=STEPS,
                         block_clients=16)
     core = build_fedcore("cnn4", fedavg(LR), plan, cfg)
-    ds_host = make_synthetic_dataset(
+    # Textured (tiled per-class pattern) population: conv-learnable by
+    # construction — Gaussian blobs are spatially incoherent and cnn4+GAP
+    # provably stays at chance on them (see _class_textures docstring).
+    ds_host = make_synthetic_texture_dataset(
         seed=SEED, num_clients=NUM_CLIENTS, n_local=N_LOCAL,
         input_shape=(32, 32, 3), num_classes=NCLS, dirichlet_alpha=0.5,
+        class_sep=SEP,
     )
-    ex, ey = make_central_eval_set(SEED, 2000, (32, 32, 3), NCLS)
+    ex, ey = make_texture_eval_set(SEED, 2000, (32, 32, 3), NCLS, class_sep=SEP)
 
     state = core.init_state(jax.random.key(0))
     base_key = jax.random.wrap_key_data(
@@ -107,7 +117,7 @@ def main():
 
 def _write_record(curves, t0):
     rec = {
-        "task": "fedavg_cifar10_cnn4 (synthetic CIFAR-shape blobs, "
+        "task": "fedavg_cifar10_cnn4 (synthetic tiled-texture images, "
                 "dirichlet 0.5 non-IID)",
         "num_clients": NUM_CLIENTS,
         "cohort": COHORT,
@@ -115,6 +125,8 @@ def _write_record(curves, t0):
         "local_steps": STEPS,
         "batch": BATCH,
         "lr": LR,
+        "class_sep": SEP,
+        "data": "tiled-texture synthetic",
         "final_acc_engine": curves[-1]["acc_engine"],
         "final_acc_oracle": curves[-1]["acc_oracle"],
         "final_delta": round(
@@ -125,14 +137,18 @@ def _write_record(curves, t0):
         "wall_sec": round(time.time() - t0, 1),
         "curves": curves,
     }
-    out = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "PARITY_convergence.json",
-    )
-    tmp = out + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(rec, f, indent=1)
-    os.replace(tmp, out)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Always keep the in-progress record in .partial.json; only publish the
+    # gated name once the run satisfies the CI gate's minimum rounds, so a
+    # mid-regeneration tree never carries a gate-failing artifact.
+    targets = [os.path.join(root, "PARITY_convergence.partial.json")]
+    if rec["rounds"] >= 30:
+        targets.append(os.path.join(root, "PARITY_convergence.json"))
+    for out in targets:
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(tmp, out)
     return rec
 
 

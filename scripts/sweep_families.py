@@ -1,0 +1,74 @@
+"""Sweep block/unroll/carry for every benchmark family on the real chip.
+
+The round-2 sweep of resnet/distilbert/vit was cut short by the tunnel
+wedge; this packages the whole remaining measurement campaign as ONE
+command for the next session with working hardware:
+
+    python scripts/sweep_families.py            # full grid
+    python scripts/sweep_families.py --quick    # 1 block per family
+
+Every configuration runs in its own subprocess with a hard timeout
+(bench.py's isolation — a wedged compile loses one point, not the sweep),
+and SWEEP.json is rewritten after every point, so a dead tunnel still
+leaves everything measured so far. Finish by copying the winners into
+bench.py's HEADLINE_FAMILY / SUITE_FAMILIES.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+GRID_BLOCKS = [8, 16, 32]
+CARRIES = [None, "bf16"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="one block per family, f32 carry only")
+    ap.add_argument("--family", default=None,
+                    help="sweep only the named family")
+    args = ap.parse_args()
+
+    families = [dict(bench.HEADLINE_FAMILY, timed_rounds=2)] + [
+        dict(f) for f in bench.SUITE_FAMILIES
+    ]
+    if args.family:
+        families = [f for f in families if f["name"] == args.family]
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SWEEP.json")
+    results = []
+    for fam in families:
+        blocks = [fam["block"]] if args.quick else GRID_BLOCKS
+        carries = [None] if args.quick else CARRIES
+        unrolls = sorted({1, fam.get("local_steps", 10)})
+        for block in blocks:
+            for unroll in unrolls:
+                for carry in carries:
+                    cfg = dict(fam, block=block, unroll=unroll)
+                    if carry:
+                        cfg["carry"] = carry
+                    rec = bench.run_family_subprocess(cfg)
+                    rec.setdefault("family", fam["name"])
+                    rec.update(block=block, unroll=unroll,
+                               carry=carry or "f32")
+                    results.append(rec)
+                    print(json.dumps(rec), flush=True)
+                    with open(out_path, "w") as f:
+                        json.dump(results, f, indent=1)
+
+    best = {}
+    for rec in results:
+        rps = rec.get("rounds_per_sec")
+        if rps and rps > best.get(rec["family"], {}).get("rounds_per_sec", 0):
+            best[rec["family"]] = rec
+    print("BEST:", json.dumps(best, indent=1))
+
+
+if __name__ == "__main__":
+    main()
