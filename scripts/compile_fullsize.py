@@ -26,7 +26,7 @@ if os.environ.get("JAX_PLATFORMS"):
 
 import numpy as np
 
-from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine import build_fedcore, make_synthetic_dataset
 from olearning_sim_tpu.engine.fedcore import FedCoreConfig
 from olearning_sim_tpu.parallel.mesh import make_mesh_plan
 
@@ -40,16 +40,22 @@ def main():
                         max_local_steps=fam["local_steps"],
                         block_clients=fam["block"],
                         step_unroll=fam["unroll"])
-    alg_name, alg_kw = fam["algorithm"]
-    core = build_fedcore(fam["model"], fedavg(alg_kw["local_lr"]), plan, cfg)
+    core = build_fedcore(
+        fam["model"], bench.make_algorithm(fam["algorithm"]), plan, cfg
+    )
     ds = make_synthetic_dataset(
         seed=0, num_clients=fam["num_clients"], n_local=fam["n_local"],
         input_shape=tuple(fam["input_shape"]),
         num_classes=fam["num_classes"], dirichlet_alpha=0.5,
     ).pad_for(plan, cfg.block_clients).place(plan)
     state = core.init_state(jax.random.key(0))
-    num_steps = jax.numpy.full(
-        (ds.num_clients,), fam["local_steps"], jax.numpy.int32
+    # Placed exactly as round_step places it (client axis over dp) so the
+    # lowered program's argument shardings match the benchmarked one.
+    from olearning_sim_tpu.parallel.mesh import global_put
+
+    num_steps = global_put(
+        np.full((ds.num_clients,), fam["local_steps"], np.int32),
+        plan.client_sharding(),
     )
 
     t0 = time.time()
