@@ -29,6 +29,7 @@ import gzip
 import io
 import json
 import os
+import pickle
 import struct
 from typing import Dict, List, Optional, Tuple
 
@@ -117,6 +118,33 @@ def load_cifar_dir(d: str, split: str = "train", coarse: bool = False) -> Parsed
     return x.astype(np.float32) / 255.0, y, None
 
 
+class _CifarUnpickler(pickle.Unpickler):
+    """Unpickler allowing only what the published CIFAR batches contain:
+    plain containers (handled without ``find_class``) and numpy array
+    reconstruction. Everything else — ``os.system``, ``builtins.eval``,
+    arbitrary class instantiation — raises instead of importing."""
+
+    _ALLOWED = {
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "_reconstruct"),  # numpy >= 2 module name
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy", "ndarray"),
+        ("numpy", "dtype"),
+        # protocol-2 pickles route py2-str/bytes payloads through
+        # _codecs.encode (side-effect-free byte encoding) — the genuine
+        # python-2 CIFAR batches need it under encoding="bytes".
+        ("_codecs", "encode"),
+    }
+
+    def find_class(self, module, name):  # noqa: D102 — see class docstring
+        if (module, name) in self._ALLOWED:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"global {module}.{name} forbidden in CIFAR batch pickles"
+        )
+
+
 def load_cifar_python_dir(d: str, split: str = "train", coarse: bool = False) -> Parsed:
     """CIFAR-10/100 "python version" — the format of the actually-published
     ``cifar-10-python.tar.gz`` / ``cifar-100-python.tar.gz`` archives: pickled
@@ -124,11 +152,12 @@ def load_cifar_python_dir(d: str, split: str = "train", coarse: bool = False) ->
     ``labels`` / ``fine_labels``+``coarse_labels``. File names:
     ``data_batch_1..5``/``test_batch`` (CIFAR-10) or ``train``/``test``
     (CIFAR-100). Keys may be bytes (the published files are python-2
-    pickles). Unpickling is for trusted task archives only — the same trust
-    model as the reference's downloaded task data
-    (``utils_run_task.py:174-325``)."""
-    import pickle
-
+    pickles). Unpickling is RESTRICTED: the published batches need nothing
+    beyond dict/list/bytes plus numpy array reconstruction, so
+    :class:`_CifarUnpickler` refuses every other global — a malicious
+    pickle arriving through the remote FileRepo download path gets
+    ``UnpicklingError``, not code execution (the reference trusts its
+    downloaded task data outright, ``utils_run_task.py:174-325``)."""
     names = sorted(os.listdir(d))
     if any(n.startswith("data_batch") for n in names):
         files = ([n for n in names if n.startswith("data_batch")]
@@ -147,7 +176,7 @@ def load_cifar_python_dir(d: str, split: str = "train", coarse: bool = False) ->
     xs, ys = [], []
     for n in files:
         with open(os.path.join(d, n), "rb") as f:
-            blob = pickle.load(f, encoding="bytes")
+            blob = _CifarUnpickler(f, encoding="bytes").load()
         xs.append(np.asarray(get(blob, "data"), np.uint8))
         ys.append(np.asarray(get(blob, label_key), np.int32))
     x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)

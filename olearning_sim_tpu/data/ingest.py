@@ -78,10 +78,21 @@ def fetch_dataset_dir(
                 # traversal, links outside the root, and device/sticky bits.
                 tf.extractall(out, filter="data")
             except TypeError:
-                # Older interpreters: the same traversal guard as the zip
-                # branch, by hand.
+                # Older interpreters: the zip branch's traversal guard, by
+                # hand — plus a link-member rejection the zip branch does
+                # not need (zipfile never materializes symlinks, tarfile
+                # does: a symlink pointing outside the root followed by a
+                # member extracting *through* it would pass a name-only
+                # realpath check, because the realpath runs before the
+                # symlink exists on disk).
                 root = os.path.realpath(out)
                 for m in tf.getmembers():
+                    if m.issym() or m.islnk():
+                        raise ValueError(
+                            f"tar link member rejected: {m.name!r} -> "
+                            f"{m.linkname!r} (published dataset archives "
+                            f"contain no links)"
+                        )
                     target = os.path.realpath(os.path.join(out, m.name))
                     if not target.startswith(root + os.sep):
                         raise ValueError(

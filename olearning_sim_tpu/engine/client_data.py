@@ -42,6 +42,12 @@ class ClientDataset:
     client_uid: np.ndarray | jax.Array
     weight: np.ndarray | jax.Array
     num_real_clients: int
+    # Size of the LOGICAL population this dataset was drawn from. Differs
+    # from num_real_clients only after :meth:`take`: a cohort subset keeps
+    # the parent's population size so SCAFFOLD's server-control fraction
+    # |S|/N (eq. 5) sees the true N under partial participation instead of
+    # collapsing to ~1 (ADVICE r3). None -> num_real_clients.
+    population_size: Optional[int] = None
 
     @property
     def num_clients(self) -> int:
@@ -51,8 +57,18 @@ class ClientDataset:
     def n_local(self) -> int:
         return int(self.x.shape[1])
 
+    @property
+    def population(self) -> int:
+        """True unpadded population size N (survives cohort take())."""
+        return (self.num_real_clients if self.population_size is None
+                else self.population_size)
+
     def take(self, indices) -> "ClientDataset":
-        """Host-side row selection (cohort sampling / subsetting)."""
+        """Host-side row selection (cohort sampling / subsetting).
+
+        The result remembers the parent's :attr:`population` so
+        fraction-of-population semantics (SCAFFOLD server control) are
+        preserved across cohort subsetting."""
         idx = np.asarray(indices)
         return ClientDataset(
             x=np.asarray(self.x)[idx],
@@ -61,6 +77,7 @@ class ClientDataset:
             client_uid=np.asarray(self.client_uid)[idx],
             weight=np.asarray(self.weight)[idx],
             num_real_clients=int(len(idx)),
+            population_size=self.population,
         )
 
     def pad_for(self, plan: MeshPlan, block: int) -> "ClientDataset":
@@ -83,6 +100,7 @@ class ClientDataset:
             client_uid=pad0(self.client_uid),
             weight=pad0(self.weight),
             num_real_clients=self.num_real_clients,
+            population_size=self.population_size,
         )
 
     def place(self, plan: MeshPlan, feature_dtype=jnp.bfloat16) -> "ClientDataset":
@@ -108,6 +126,7 @@ class ClientDataset:
             client_uid=put(np.asarray(self.client_uid, np.int32)),
             weight=put(np.asarray(self.weight, np.float32)),
             num_real_clients=self.num_real_clients,
+            population_size=self.population_size,
         )
 
 

@@ -604,9 +604,10 @@ class FedCore:
             if controlled:
                 # c <- c + (|S|/N) * weighted-mean dc_i (SCAFFOLD eq. 5 with
                 # aggregation weights). N is the TRUE unpadded population
-                # (ds.num_real_clients, threaded in as a scalar) so the
-                # server-control trajectory is identical under any
-                # dp/block_clients padding of the same logical population.
+                # (ds.population, threaded in as a scalar): it survives both
+                # dp/block_clients padding AND cohort take() subsetting, so
+                # partial participation keeps frac = |S|/N instead of
+                # collapsing to ~1 (ADVICE r3).
                 sum_dc = jax.lax.psum(sum_dc, "dp")
                 frac = count / jnp.maximum(true_n, 1.0)
                 new_server_c = jax.tree.map(
@@ -796,7 +797,7 @@ class FedCore:
                 )
             return self._round_step(
                 state, control, ds.x, ds.y, ds.num_samples, num_steps,
-                ds.client_uid, weight, jnp.float32(ds.num_real_clients),
+                ds.client_uid, weight, jnp.float32(ds.population),
             )
         if control is not None:
             raise ValueError(

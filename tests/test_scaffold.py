@@ -127,3 +127,47 @@ def test_scaffold_beats_fedavg_under_drift():
         results[name] = acc
     # SCAFFOLD should not be (meaningfully) worse; typically better.
     assert results["scaffold"] >= results["fedavg"] - 0.02, results
+
+
+def test_scaffold_frac_survives_cohort_take():
+    """SCAFFOLD eq. 5: the server control moves by (|S|/N) * wmean(dc).
+    A cohort expressed via take() must keep the PARENT population N, so the
+    same cohort trained as a take()-subset moves the server control by
+    cohort/population of what a standalone population of that size would
+    (ADVICE r3: take() used to reset N to the subset size, collapsing
+    frac to ~1)."""
+    import dataclasses
+
+    plan = make_mesh_plan(dp=8, mp=1)
+    cfg = FedCoreConfig(batch_size=8, max_local_steps=5, block_clients=1)
+    core = build_fedcore(
+        "mlp2", scaffold(local_lr=0.1), plan, cfg,
+        model_overrides={"hidden": (32,), "num_classes": NUM_CLASSES},
+        input_shape=INPUT_SHAPE,
+    )
+    ds_host = make_synthetic_dataset(
+        SEED, 32, 24, INPUT_SHAPE, NUM_CLASSES, class_sep=4.0
+    )
+    cohort = ds_host.take(np.arange(8))
+    assert cohort.num_real_clients == 8 and cohort.population == 32
+    sub = cohort.pad_for(plan, 1).place(plan)
+    assert sub.population == 32  # survives pad_for + place
+    # Identical data treated as a standalone 8-client population (N = 8).
+    standalone = dataclasses.replace(cohort, population_size=None)
+    standalone = standalone.pad_for(plan, 1).place(plan)
+    assert standalone.population == 8
+
+    def server_delta(ds):
+        state = core.init_state(jax.random.key(0))
+        control = core.init_control(state, ds.num_clients)
+        _, _, new_control = core.round_step(state, ds, control=control)
+        return np.concatenate([
+            np.ravel(np.asarray(leaf, np.float64))
+            for leaf in jax.tree.leaves(new_control.server_control)
+        ])
+
+    d_sub, d_alone = server_delta(sub), server_delta(standalone)
+    # Same clients, same RNG streams (uids preserved) -> same wmean(dc);
+    # only frac differs: 8/32 vs 8/8.
+    np.testing.assert_allclose(d_sub * 4.0, d_alone, rtol=1e-4, atol=1e-6)
+    assert float(np.abs(d_alone).max()) > 0.0
