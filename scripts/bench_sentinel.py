@@ -1,0 +1,215 @@
+"""Stand at the door: capture the TPU measurement campaign the moment the
+axon tunnel heals.
+
+The single-chip tunnel has been wedged for two consecutive rounds (a killed
+client's device grant is never released; new processes hang forever in the
+claim loop), so the headline perf number has gone unmeasured since round 1.
+This sentinel loops forever:
+
+  1. probe the accelerator with a tiny op in a subprocess under a hard
+     timeout (the only wedge-safe way to ask "is the chip back?");
+  2. on the first success, run the staged capture queue below — each stage
+     a subprocess with its own timeout, state checkpointed after every
+     stage so a re-wedge mid-campaign only loses the in-flight stage;
+  3. keep probing afterwards: stages that failed are retried on the next
+     heal, stages that succeeded are never re-run.
+
+Run it in the background from the first minute of the session:
+
+    nohup python scripts/bench_sentinel.py > sentinel.out 2>&1 &
+
+State lives in SENTINEL_state.json (stage -> done/failed + timestamps);
+the log narrates every probe. Artifacts land exactly where the round
+expects them: BENCH_tpu.json, BENCH_suite.json, BENCH_tpu_bf16.json,
+SWEEP.json, COMPILE_fullsize.json, PARITY_convergence_tpu.json.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STATE_PATH = os.path.join(REPO, "SENTINEL_state.json")
+PROBE_TIMEOUT_S = int(os.environ.get("OLS_SENTINEL_PROBE_TIMEOUT", "120"))
+PROBE_INTERVAL_S = int(os.environ.get("OLS_SENTINEL_PROBE_INTERVAL", "180"))
+
+# A tiny op through the default (hardware) platform. Mirrors
+# bench.probe_backend but standalone so the sentinel has no import-time
+# JAX dependency in the parent process.
+_PROBE_SRC = (
+    "import jax\n"
+    "x = jax.numpy.ones((8, 8))\n"
+    "float((x @ x).sum())\n"
+    "print('SENTINEL_PROBE_OK', jax.default_backend(), flush=True)\n"
+)
+
+
+def log(msg):
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+    print(f"[{stamp}] {msg}", flush=True)
+
+
+def probe():
+    """Returns the backend name if the accelerator answers, else None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC], timeout=PROBE_TIMEOUT_S,
+            capture_output=True, text=True, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("SENTINEL_PROBE_OK"):
+            return line.split()[1]
+    return None
+
+
+def run_stage(name, cmd, timeout_s, env_extra=None, stdout_to=None):
+    """One capture stage in a subprocess. Returns (ok, note)."""
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    log(f"stage {name}: {' '.join(cmd)} (timeout {timeout_s}s)")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, timeout=timeout_s, capture_output=True, text=True,
+            cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = e.stderr or b""
+        if isinstance(tail, bytes):
+            tail = tail.decode("utf-8", "replace")
+        return False, f"timeout after {timeout_s}s; stderr tail: {tail[-300:]}"
+    dt = time.time() - t0
+    logdir = os.path.join(REPO, "artifacts")
+    os.makedirs(logdir, exist_ok=True)
+    with open(os.path.join(logdir, f"sentinel_{name}.log"), "w") as f:
+        f.write(proc.stdout)
+        f.write("\n--- stderr ---\n")
+        f.write(proc.stderr[-20000:])
+    if proc.returncode != 0:
+        return False, f"rc={proc.returncode} after {dt:.0f}s: {proc.stderr[-300:]}"
+    if stdout_to is not None:
+        # The last JSON-looking stdout line is the record (bench.py prints
+        # exactly one).
+        record = None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                record = line
+        if record is None:
+            return False, f"no JSON line in stdout after {dt:.0f}s"
+        rec = json.loads(record)
+        if rec.get("detail", {}).get("degraded"):
+            return False, f"record degraded (backend {rec['detail'].get('backend')})"
+        rec.setdefault("detail", {})["captured_unix"] = time.time()
+        with open(os.path.join(REPO, stdout_to), "w") as f:
+            json.dump(rec, f, indent=1)
+        log(f"stage {name}: wrote {stdout_to} "
+            f"(value={rec.get('value')}, vs_baseline={rec.get('vs_baseline')})")
+    return True, f"ok in {dt:.0f}s"
+
+
+# The campaign, cheapest-first so a short heal window still banks the
+# highest-value numbers. Stage envs force isolation so every family runs
+# in its own grant-scoped subprocess (axon grants serialize per-process).
+STAGES = [
+    # 1. Headline only, fast: the metric of record, ~5 min.
+    ("headline_fast",
+     [sys.executable, "bench.py"],
+     2400, {"OLS_BENCH_FAST": "1"}, "BENCH_tpu.json"),
+    # 2. bf16-carry headline A/B (weak #4): same shape, carry lever on.
+    ("headline_bf16",
+     [sys.executable, "bench.py"],
+     2400, {"OLS_BENCH_FAST": "1", "OLS_BENCH_CARRY": "bf16"},
+     "BENCH_tpu_bf16.json"),
+    # 3. Full suite: headline + all five families -> BENCH_suite.json.
+    ("full_suite",
+     [sys.executable, "bench.py"],
+     7200, {}, "BENCH_tpu.json"),
+    # 4. Block/unroll sweep for the four never-measured families (weak #2).
+    ("sweep_families",
+     [sys.executable, "scripts/sweep_families.py", "--untuned"],
+     10800, {}, None),
+    # 5. Headline profile: block_unroll probes + HLO cost + trace.
+    ("profile",
+     [sys.executable, "scripts/profile_headline.py", "--quick", "--cost",
+      "--trace"],
+     3600, {}, None),
+    # 5b. Ring-attention per-step primitive A/B (verdict r3 weak #7).
+    ("ring_step",
+     [sys.executable, "scripts/bench_ring_step.py"],
+     3600, {}, None),
+    # 5c. Packed-client conv lever at headline L1 shapes (verdict #2).
+    ("conv_packed",
+     [sys.executable, "scripts/microbench_conv_packed.py"],
+     3600, {}, None),
+    # 6. TPU-lowered full-size memory analysis (verdict #4).
+    ("compile_fullsize",
+     [sys.executable, "scripts/compile_fullsize.py"],
+     3600, {}, None),
+    # 7. TPU engine leg of convergence parity (verdict #3, hard regime).
+    ("convergence_tpu",
+     [sys.executable, "scripts/convergence_parity.py", "--backend", "tpu",
+      "--class-sep", "0.35", "--rounds", "40",
+      "--out", "PARITY_convergence_tpu.json"],
+     10800, {}, None),
+]
+
+
+def load_state():
+    if os.path.exists(STATE_PATH):
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    return {"stages": {}, "probes": 0, "first_heal_unix": None}
+
+
+def save_state(state):
+    with open(STATE_PATH, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def main():
+    state = load_state()
+    log(f"sentinel up; {len(STAGES)} stages, "
+        f"probe every {PROBE_INTERVAL_S}s (timeout {PROBE_TIMEOUT_S}s)")
+    while True:
+        pending = [s for s in STAGES if state["stages"].get(s[0]) != "done"]
+        if not pending:
+            log("campaign complete — all stages done; exiting")
+            return
+        backend = probe()
+        state["probes"] += 1
+        if backend is None or backend == "cpu":
+            if state["probes"] % 10 == 1:
+                log(f"probe #{state['probes']}: tunnel still dead "
+                    f"(backend={backend}); {len(pending)} stages pending")
+            save_state(state)
+            time.sleep(PROBE_INTERVAL_S)
+            continue
+        if state["first_heal_unix"] is None:
+            state["first_heal_unix"] = time.time()
+        log(f"probe #{state['probes']}: TUNNEL ALIVE (backend={backend}) — "
+            f"running {len(pending)} pending stages")
+        save_state(state)
+        for name, cmd, timeout_s, env_extra, stdout_to in pending:
+            ok, note = run_stage(name, cmd, timeout_s, env_extra, stdout_to)
+            state["stages"][name] = "done" if ok else "failed"
+            state[f"note_{name}"] = note
+            save_state(state)
+            log(f"stage {name}: {'DONE' if ok else 'FAILED'} — {note}")
+            if not ok:
+                # Re-probe before burning the next stage's timeout on a
+                # freshly re-wedged tunnel.
+                if probe() in (None, "cpu"):
+                    log("tunnel re-wedged mid-campaign; back to probing")
+                    break
+        time.sleep(PROBE_INTERVAL_S)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,7 @@ to ``PARITY_carry_bf16.json`` — convergence-scale gating evidence for the
 perf lever beyond test_bf16_carry_parity's CI scale.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -31,12 +32,34 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
 ))
 
+_ap = argparse.ArgumentParser()
+_ap.add_argument("--class-sep", type=float,
+                 default=float(os.environ.get("OLS_PARITY_SEP", "1.0")),
+                 help="texture separation; 1.0 saturates ~99%% — use ~0.35 "
+                      "for the non-saturated 60-80%% regime (VERDICT r3 #3)")
+_ap.add_argument("--rounds", type=int,
+                 default=int(os.environ.get("OLS_PARITY_ROUNDS", "45")))
+_ap.add_argument("--backend", default=None,
+                 help="'cpu' forces the CPU backend; 'tpu' (or any other "
+                      "value) leaves the default hardware platform in place "
+                      "for the engine leg — the NumPy oracle is host-side "
+                      "either way, so this yields a TPU-vs-CPU numerics "
+                      "parity record")
+_ap.add_argument("--out", default=None,
+                 help="artifact basename override (e.g. "
+                      "PARITY_convergence_hard.json)")
+_ap.add_argument("--carry", default=os.environ.get("OLS_PARITY_CARRY"),
+                 help="'bf16' -> engine-only A/B of the bf16 local-SGD carry")
+_ARGS = _ap.parse_args()
+
 import jax
 
 # The sandbox sitecustomize pins JAX_PLATFORMS to the hardware plugin and
 # OVERRIDES the env var; only a config update before any backend touch
 # works (same dance as tests/conftest.py and __graft_entry__).
-if os.environ.get("JAX_PLATFORMS"):
+if _ARGS.backend == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+elif _ARGS.backend is None and os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import numpy as np
@@ -56,12 +79,12 @@ N_LOCAL = 20
 BATCH = 32
 STEPS = 10
 LR = 0.1
-SEP = 1.0
-ROUNDS = int(os.environ.get("OLS_PARITY_ROUNDS", "45"))
+SEP = _ARGS.class_sep
+ROUNDS = _ARGS.rounds
 NCLS = 10
 SEED = 5
 EVAL_EVERY = 5
-CARRY = os.environ.get("OLS_PARITY_CARRY")  # "bf16" -> engine-only A/B
+CARRY = _ARGS.carry  # "bf16" -> engine-only A/B
 
 
 def main():
@@ -160,6 +183,8 @@ def _write_record(curves, t0):
         name = "PARITY_carry_bf16"
     else:
         name = "PARITY_convergence"
+    if _ARGS.out:
+        name = _ARGS.out.removesuffix(".json")
     # Always keep the in-progress record in .partial.json; only publish the
     # gated name once the run satisfies the CI gate's minimum rounds, so a
     # mid-regeneration tree never carries (or destroys) a gate-passing
