@@ -14,10 +14,13 @@ Padding: key-side padding enters as a 0/1 mask; fully-masked query rows
 Measured position (single v5e-class chip, bf16, H=12 D=64): XLA's fused
 dense attention is faster at every L tested (10 ms vs 52 ms at L=2048) —
 XLA's attention fusion on TPU is already excellent, and this workload's
-sequences are short. This kernel's role is (a) the per-step primitive for
-ring attention, where K/V chunks are VMEM-resident by construction, and
-(b) a fusion point for attention variants XLA can't fuse (e.g. quantized
-KV). Use ``attention_impl='dense'`` for raw speed.
+sequences are short. This kernel's roles: (a) an OPTIONAL per-step
+primitive for ring attention via :func:`flash_attention_stats` +
+``ring_attention(use_flash=True)`` — default OFF because dense wins every
+measured shape; ``scripts/bench_ring_step.py`` is the A/B that would
+justify flipping it — and (b) a fusion point for attention variants XLA
+can't fuse (e.g. quantized KV). Use ``attention_impl='dense'`` for raw
+speed.
 """
 
 from __future__ import annotations
@@ -38,6 +41,38 @@ except ImportError:  # pragma: no cover
     _VMEM = None
 
 NEG_INF = -1e30
+
+
+def _attn_stats_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, m_ref, l_ref,
+                       *, scale):
+    """Like :func:`_attn_kernel` but also writes the per-row softmax stats
+    (running max ``m`` and normalizer ``l``) so an outer online-softmax
+    merge — ring attention's per-step combine — can treat this block's
+    output as one partial block. Fully-masked rows report m=0, l=0, o=0;
+    an overestimated m only rescales (acc, l) identically, so the outer
+    merge's o = acc/l is invariant to it."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    kmask = kmask_ref[0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    s = s + (1.0 - kmask) * NEG_INF
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o = o / jnp.maximum(l, 1e-20)
+    o_ref[0] = o.astype(o_ref.dtype)
+    m_ref[0] = jnp.broadcast_to(m, m_ref.shape[1:]).astype(jnp.float32)
+    l_ref[0] = jnp.broadcast_to(l, l_ref.shape[1:]).astype(jnp.float32)
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, *, scale):
@@ -65,6 +100,45 @@ def _attn_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, *, scale):
     )
     o = o / jnp.maximum(l, 1e-20)
     o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _inside_manual_axes(x) -> bool:
+    """True when ``x`` carries varying manual axes (i.e. we are tracing
+    inside a shard_map body with check_vma=True)."""
+    try:
+        return bool(jax.typeof(x).vma)
+    except (AttributeError, TypeError):
+        return False
+
+
+def _reference_stats(q, k, v, kv_mask, scale):
+    """Plain-XLA (o, m, l) with the exact semantics of the stats kernel:
+    f32 scores/softmax, m pinned to 0 and l = 0 for fully-masked rows."""
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = s + (1.0 - kv_mask.astype(jnp.float32))[:, None, None, :] * NEG_INF
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ) / jnp.maximum(l, 1e-20)
+    return o.astype(q.dtype), m[..., 0], l[..., 0]
+
+
+def _out_sds(shape, dtype, like):
+    """ShapeDtypeStruct for a pallas_call output, carrying the varying-
+    manual-axes type of ``like`` so the kernel is legal inside shard_map
+    with check_vma=True (ring attention's use_flash path)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+    except (AttributeError, TypeError):  # older jax / no vma tracking
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _pad_to(x, axis: int, multiple: int):
@@ -110,10 +184,31 @@ def flash_attention(
         kv_mask = jnp.ones((B, Lk), jnp.float32)
     kv_mask = kv_mask.astype(jnp.float32)
 
-    # Hardware alignment: lanes = 128 on the last dim, pad q-rows to the
-    # q-block and keys to the sublane multiple. Zero-padded D contributes
-    # nothing to dot products; padded keys are masked; padded q rows are
-    # sliced off below.
+    ops, grid, in_specs, bq, dims, kwargs = _prologue(
+        q, k, v, kv_mask, block_q
+    )
+    Lqp, Lkp, Dp = dims
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lqp, Dp), q.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0), **kwargs),
+        interpret=interpret,
+    )(*ops)
+    return out.reshape(B, H, Lqp, Dp)[:, :, :Lq, :D]
+
+
+def _prologue(q, k, v, kv_mask, block_q):
+    """Shared pad/reshape/grid/spec prologue of both kernel entry points.
+
+    Hardware alignment: lanes = 128 on the last dim, pad q-rows to the
+    q-block and keys to the sublane multiple. Zero-padded D contributes
+    nothing to dot products; padded keys are masked; padded q rows are
+    sliced off by the callers. Returns ``(operands, grid, in_specs, bq,
+    (Lqp, Lkp, Dp), blockspec_kwargs)``.
+    """
+    B, H, Lq, D = q.shape
     bq = min(block_q, max(8, 1 << (Lq - 1).bit_length()))
     qp = _pad_to(_pad_to(q, 3, 128), 2, bq)
     kp = _pad_to(_pad_to(k, 3, 128), 2, 8)
@@ -131,17 +226,76 @@ def flash_attention(
 
     grid = (B * H, Lqp // bq)
     kwargs = dict(memory_space=_VMEM) if _VMEM is not None else {}
-    out = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((B * H, Lqp, Dp), q.dtype),
+    in_specs = [
+        pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0), **kwargs),
+        pl.BlockSpec((1, Lkp, Dp), lambda b, i: (b, 0, 0), **kwargs),
+        pl.BlockSpec((1, Lkp, Dp), lambda b, i: (b, 0, 0), **kwargs),
+        pl.BlockSpec((1, 1, Lkp), lambda b, i: (b, 0, 0), **kwargs),
+    ]
+    return (qf, kf, vf, maskf), grid, in_specs, bq, (Lqp, Lkp, Dp), kwargs
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_q", "interpret")
+)
+def flash_attention_stats(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """:func:`flash_attention` plus per-row softmax stats.
+
+    Returns ``(o, m, l)`` with o [B, H, Lq, D] in q's dtype and m, l
+    [B, H, Lq] f32 — the running-max and normalizer of this block's online
+    softmax, so a caller merging several K/V blocks (ring attention's
+    per-step combine, ``parallel/ring_attention.py``) can fold this block
+    in exactly: ``acc_blk = o * l``. Forward-only (no custom VJP): the ring
+    TRAINING path keeps the dense per-step primitive.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, Lk), jnp.float32)
+    kv_mask = kv_mask.astype(jnp.float32)
+
+    if interpret and _inside_manual_axes(q):
+        # Pallas's HLO interpreter cannot run under shard_map with
+        # check_vma=True (its internal index ops mix varying and unvarying
+        # values); CPU CI of ring+flash uses the reference-ops stats — the
+        # kernel body itself is covered by the non-shard_map tests, and on
+        # real TPU (interpret=False) the Mosaic kernel runs everywhere.
+        return _reference_stats(q, k, v, kv_mask, scale)
+
+    ops, grid, in_specs, bq, dims, kwargs = _prologue(
+        q, k, v, kv_mask, block_q
+    )
+    Lqp, Lkp, Dp = dims
+    qf = ops[0]
+    o, m, l = pl.pallas_call(
+        functools.partial(_attn_stats_kernel, scale=scale),
+        out_shape=(
+            _out_sds((B * H, Lqp, Dp), q.dtype, qf),
+            _out_sds((B * H, Lqp, 1), jnp.float32, qf),
+            _out_sds((B * H, Lqp, 1), jnp.float32, qf),
+        ),
         grid=grid,
-        in_specs=[
+        in_specs=in_specs,
+        out_specs=(
             pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0), **kwargs),
-            pl.BlockSpec((1, Lkp, Dp), lambda b, i: (b, 0, 0), **kwargs),
-            pl.BlockSpec((1, Lkp, Dp), lambda b, i: (b, 0, 0), **kwargs),
-            pl.BlockSpec((1, 1, Lkp), lambda b, i: (b, 0, 0), **kwargs),
-        ],
-        out_specs=pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0), **kwargs),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), **kwargs),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), **kwargs),
+        ),
         interpret=interpret,
-    )(qf, kf, vf, maskf)
-    return out.reshape(B, H, Lqp, Dp)[:, :, :Lq, :D]
+    )(*ops)
+    o = o.reshape(B, H, Lqp, Dp)[:, :, :Lq, :D]
+    m = m.reshape(B, H, Lqp)[:, :, :Lq]
+    l = l.reshape(B, H, Lqp)[:, :, :Lq]
+    return o, m, l

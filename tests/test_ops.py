@@ -1,10 +1,14 @@
 """Pallas kernels (interpret mode) + ring attention vs dense references."""
 
+import os as _os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
+
+_REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
 
 from olearning_sim_tpu.ops import flash_attention
 from olearning_sim_tpu.parallel.ring_attention import RingSelfAttention, ring_attention
@@ -186,3 +190,81 @@ def test_transformer_ring_impl_wired():
     )(x, mask)
     assert out.shape == (B, L, W)
     assert np.isfinite(np.asarray(out)).all()
+
+
+# ----------------------------------------------- flash stats + ring(use_flash)
+def test_flash_stats_match_dense_and_compose():
+    """flash_attention_stats returns (o, m, l) such that o matches dense
+    attention and (m, l) are the true online-softmax stats: merging two
+    disjoint K/V halves through the stats must equal full attention."""
+    from olearning_sim_tpu.ops import flash_attention_stats
+
+    q, k, v = rand_qkv(jax.random.key(8), B=2, H=2, L=32, D=16)
+    o, m, l = flash_attention_stats(q, k, v, interpret=True)
+    ref = dense_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=2e-5)
+
+    # manual two-block merge: acc_blk = o_blk * l_blk
+    o1, m1, l1 = flash_attention_stats(q, k[:, :, :16], v[:, :, :16],
+                                       interpret=True)
+    o2, m2, l2 = flash_attention_stats(q, k[:, :, 16:], v[:, :, 16:],
+                                       interpret=True)
+    m1, l1 = m1[..., None], l1[..., None]
+    m2, l2 = m2[..., None], l2[..., None]
+    m12 = jnp.maximum(m1, m2)
+    a1, a2 = jnp.exp(m1 - m12), jnp.exp(m2 - m12)
+    ln = a1 * l1 + a2 * l2
+    acc = (a1 * o1.astype(jnp.float32) * l1
+           + a2 * o2.astype(jnp.float32) * l2)
+    np.testing.assert_allclose(np.asarray(acc / ln), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_flash_stats_fully_masked_rows():
+    from olearning_sim_tpu.ops import flash_attention_stats
+
+    q, k, v = rand_qkv(jax.random.key(9), B=1, L=8)
+    mask = jnp.zeros((1, 8), bool)
+    o, m, l = flash_attention_stats(q, k, v, kv_mask=mask, interpret=True)
+    np.testing.assert_allclose(np.asarray(o), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(l), 0.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_use_flash_matches_dense(sp):
+    """ring_attention(use_flash=True): Pallas per-step primitive composes
+    through the ring merge to the same global attention (interpret mode —
+    the perf choice is scripts/bench_ring_step.py's job, VERDICT r3 #6)."""
+    mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+    q, k, v = rand_qkv(jax.random.key(10), B=2, H=2, L=32, D=16)
+    mask = jnp.arange(32)[None, :] < jnp.array([[32], [21]])
+
+    def body(q, k, v, mask):
+        return ring_attention(q, k, v, mask, "sp", use_flash=True)
+
+    spec4 = P(None, None, "sp", None)
+    out = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(spec4, spec4, spec4, P(None, "sp")),
+            out_specs=spec4,
+        )
+    )(q, k, v, mask)
+    ref = dense_reference(q, k, v, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_packed_client_conv_matches_vmap_conv():
+    """The packed-client first-conv lever (scripts/microbench_conv_packed):
+    block-diagonal packing of P clients' kernels + dense K-concat of their
+    patch rows must reproduce vmap-conv exactly, fwd and dW — the CI gate
+    for the MXU-ceiling experiment (VERDICT r3 #2)."""
+    import importlib
+    import sys as _sys
+
+    _sys.path.insert(0, _os.path.join(_REPO, "scripts"))
+    try:
+        mb = importlib.import_module("microbench_conv_packed")
+        mb.check_numerics()
+    finally:
+        _sys.path.pop(0)
