@@ -23,7 +23,10 @@ round, and checkpoints.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
+import time
 from typing import Callable, List, Optional
 
 from olearning_sim_tpu.clustermgr.launcher import MultiHostLauncher
@@ -63,6 +66,13 @@ class ElasticWorldRunner:
         self.coordinator_port = int(coordinator_port)
         self.segment_timeout = segment_timeout
         self.world_history: List[int] = []  # world size per executed segment
+        # Per-segment rescale-latency accounting: wall time of the whole
+        # relaunch (parent view) + the child's phase breakdown (written by
+        # rank 0 into <ckpt_dir>/segment_stats). This is the measured cost
+        # of checkpoint-restart elasticity vs the reference's in-place
+        # replica patch (kuberay_cluster_manager.py:112-162) — see
+        # docs/DESIGN.md "Elasticity cost".
+        self.segment_stats: List[dict] = []
         self._lock = threading.Lock()
 
     def request_rescale(self, num_devices: int) -> None:
@@ -102,11 +112,56 @@ class ElasticWorldRunner:
                 "OLS_ELASTIC_UNTIL": str(until),
                 **(extra_env or {}),
             }
+            t0 = time.perf_counter()
             launcher.launch(self.target, timeout=self.segment_timeout,
                             extra_env=env)
+            wall = time.perf_counter() - t0
             self.world_history.append(world)
+            self.segment_stats.append({
+                "segment": segment,
+                "world": world,
+                "rounds": until - done,
+                "launch_wall_sec": round(wall, 3),
+                "child": self._read_child_stats(until, world),
+            })
             done = until
             segment += 1
             if between_segments is not None and done < total_rounds:
                 between_segments(segment, done)
         return self.world_history
+
+    def _read_child_stats(self, until: int, world: int) -> Optional[dict]:
+        path = os.path.join(self.ckpt_dir, "segment_stats",
+                            f"segment_r{until}_w{world}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def overhead_summary(self) -> dict:
+        """Aggregate elasticity overhead across executed segments.
+
+        ``overhead`` = launch wall minus the child's STEADY-STATE training
+        time (steady_round_sec x rounds) — i.e. process spawn + distributed
+        init + compile + restore + checkpoint, everything the reference's
+        in-place patch does not pay. The first round's compile is overhead,
+        not training, so it is deliberately excluded from the subtrahend.
+        """
+        total_wall = sum(s["launch_wall_sec"] for s in self.segment_stats)
+        train = sum(
+            (s["child"] or {}).get("steady_round_sec", 0.0)
+            * (s["child"] or {}).get("rounds", 0)
+            for s in self.segment_stats
+        )
+        have_child = [s for s in self.segment_stats if s["child"]]
+        return {
+            "segments": len(self.segment_stats),
+            "total_wall_sec": round(total_wall, 3),
+            "train_sec": round(train, 3),
+            "overhead_sec": round(total_wall - train, 3),
+            "overhead_per_segment_sec": round(
+                (total_wall - train) / max(len(self.segment_stats), 1), 3
+            ),
+            "child_stats_found": len(have_child),
+        }

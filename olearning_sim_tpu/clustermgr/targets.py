@@ -260,6 +260,10 @@ def elastic_segment() -> int:
     from olearning_sim_tpu.engine.fedcore import FedCoreConfig
     from olearning_sim_tpu.parallel.mesh import make_mesh_plan
 
+    import json
+    import time
+
+    t0 = time.perf_counter()
     ckdir = os.environ["OLS_ELASTIC_CKPT_DIR"]
     until = int(os.environ["OLS_ELASTIC_UNTIL"])
 
@@ -286,14 +290,42 @@ def elastic_segment() -> int:
         state = states["d"]
         history = list(history)
     start = int(jax.device_get(state.round_idx))
+    restore_done = time.perf_counter()
     loss = float("nan")
+    first_round_done = None
     for r in range(start, until):
         state, metrics = core.round_step(state, ds)
         loss = float(jax.device_get(metrics.mean_loss))
+        if first_round_done is None:
+            first_round_done = time.perf_counter()  # includes the compile
         assert np.isfinite(loss), f"round {r}: non-finite loss"
         history.append({"round": r, "loss": loss, "world": n})
+    train_done = time.perf_counter()
     cp.save(until - 1, {"d": state}, {}, history)
     cp.wait()
     cp.close()
+    ckpt_done = time.perf_counter()
+    if jax.process_index() == 0:
+        # Rescale-latency accounting (VERDICT r3 #7): everything except
+        # steady-state rounds is elasticity overhead vs the reference's
+        # in-place replica patch. ElasticWorldRunner collects these.
+        stats_dir = os.path.join(ckdir, "segment_stats")
+        os.makedirs(stats_dir, exist_ok=True)
+        rounds = max(until - start, 1)
+        steady = (train_done - first_round_done) / max(rounds - 1, 1) \
+            if first_round_done is not None else 0.0
+        with open(os.path.join(stats_dir, f"segment_r{until}_w{n}.json"),
+                  "w") as f:
+            json.dump({
+                "world": n,
+                "rounds": until - start,
+                "setup_restore_sec": round(restore_done - t0, 3),
+                "first_round_incl_compile_sec": round(
+                    (first_round_done or restore_done) - restore_done, 3),
+                "steady_round_sec": round(steady, 3),
+                "train_sec": round(train_done - restore_done, 3),
+                "checkpoint_sec": round(ckpt_done - train_done, 3),
+                "total_sec": round(ckpt_done - t0, 3),
+            }, f)
     print(f"elastic_segment ok: world={n} rounds {start}->{until} loss={loss:.4f}")
     return 0

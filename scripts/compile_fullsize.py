@@ -1,15 +1,27 @@
 """AOT-compile the FULL-SIZE headline round program and record its memory
-footprint.
+footprint — against the REAL TPU lowering whenever possible.
 
-VERDICT r2 weak #7: no benchmark family had ever been built at its stated
-scale. Executing 10k clients x 10 local steps on CPU is hours per round,
-but the *program* — the exact jitted round_step the TPU runs, at the exact
-10k-client shapes — can be lowered and compiled anywhere. This does that
-and records XLA's memory analysis (argument/output/temp/generated-code
-bytes), which is the HBM budget the program needs on a real chip
-(v5e: 16 GB). Writes COMPILE_fullsize.json.
+VERDICT r3 weak #5: the committed memory analysis came from the XLA:CPU
+lowering, which tiles convolutions and chooses temp buffers differently
+from XLA:TPU, so its "3.5 GB vs 16 GB v5e HBM" was indicative only. The
+fix discovered this round: ``jax.experimental.topologies`` builds a PJRT
+TopologyDescription from libtpu WITHOUT claiming any device — immune to
+the axon tunnel wedge — and a jit can be lowered and compiled against one
+device of that topology from pure ShapeDtypeStructs (no data, no
+execution). That yields the authoritative XLA:TPU memory analysis for the
+exact 10k-client program the bench runs.
 
-Run: JAX_PLATFORMS=cpu python scripts/compile_fullsize.py
+Modes (auto-selected):
+  1. topology AOT (default): v5e topology, devices[0], abstract args.
+  2. ``--live`` or OLS_COMPILE_LIVE=1: compile on the session's default
+     backend (the old behavior; works on CPU via JAX_PLATFORMS=cpu).
+
+Also compiles the bf16-carry variant of the same program (VERDICT r3
+next #4). Writes COMPILE_fullsize.json:
+  {"backend": ..., "programs": {"f32_carry": {...}, "bf16_carry": {...}}}
+
+Run: python scripts/compile_fullsize.py          # topology AOT, no device
+     JAX_PLATFORMS=cpu python scripts/compile_fullsize.py --live  # CPU
 """
 
 import json
@@ -21,68 +33,99 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+# An explicit JAX_PLATFORMS=cpu implies the live-CPU path (the documented
+# pre-topology invocation keeps working on machines without libtpu).
+LIVE = ("--live" in sys.argv or os.environ.get("OLS_COMPILE_LIVE") == "1"
+        or os.environ.get("JAX_PLATFORMS", "").startswith("cpu"))
 
+if LIVE and os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+elif not LIVE:
+    # Topology mode must NEVER initialize the default (axon) backend — a
+    # single stray concrete op (e.g. jax.random.key) would try to claim
+    # the possibly-wedged device and hang the whole script. Pinning the
+    # process platform to cpu makes any accidental concrete op harmless;
+    # the AOT compile itself targets TPU via the topology's devices.
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
 import numpy as np
 
-from olearning_sim_tpu.engine import build_fedcore, make_synthetic_dataset
+from olearning_sim_tpu.engine import build_fedcore
 from olearning_sim_tpu.engine.fedcore import FedCoreConfig
 from olearning_sim_tpu.parallel.mesh import make_mesh_plan
 
+GB = 1024 ** 3
 
-def main():
+
+def get_device():
+    """One device to compile against + the backend label."""
+    if LIVE:
+        return jax.devices()[0], jax.default_backend(), len(jax.devices())
+    from jax.experimental import topologies
+
+    # v5e:2x2 is the smallest layout divisible by the default 2x2x1
+    # chips-per-host bounds; we compile against ONE of its devices, which
+    # is exactly the single-chip headline target. No device grant is
+    # touched — this works while the tunnel is wedged.
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    return topo.devices[0], "tpu (v5e topology AOT, no device claimed)", 1
+
+
+def abstract_args(core, fam, plan):
+    """ShapeDtypeStructs for round_step at the exact benchmarked shapes —
+    no data materialized (topology devices cannot hold arrays). Identical
+    for the f32 and bf16-carry programs: carry_dtype only changes the
+    scan carry inside the program, never the argument shapes."""
+    from olearning_sim_tpu.parallel.mesh import shard_clients
+
+    padded, _ = shard_clients(fam["num_clients"], plan, fam["block"])
+    C, n = padded, fam["n_local"]
+    feat = tuple(fam["input_shape"])
+    sds = jax.ShapeDtypeStruct
+    # Key creation stays INSIDE eval_shape: a concrete jax.random.key(0)
+    # would initialize the default backend (see the platform pin above).
+    state = jax.eval_shape(lambda: core.init_state(jax.random.key(0)))
+    return (
+        state,
+        sds((C, n) + feat, jnp.bfloat16),   # x, as ClientDataset.place casts
+        sds((C, n), jnp.int32),              # y
+        sds((C,), jnp.int32),                # num_samples
+        sds((C,), jnp.int32),                # num_steps
+        sds((C,), jnp.int32),                # client_uid
+        sds((C,), jnp.float32),              # weight
+    )
+
+
+def compile_one(fam, device, carry=None):
+    plan = make_mesh_plan(devices=[device], dp=1, mp=1)
+    cfg = FedCoreConfig(
+        batch_size=fam["batch"], max_local_steps=fam["local_steps"],
+        block_clients=fam["block"], step_unroll=fam["unroll"],
+        carry_dtype=jnp.bfloat16 if carry == "bf16" else None,
+    )
     import bench
 
-    fam = bench.HEADLINE_FAMILY  # the exact headline configuration
-    plan = make_mesh_plan()
-    cfg = FedCoreConfig(batch_size=fam["batch"],
-                        max_local_steps=fam["local_steps"],
-                        block_clients=fam["block"],
-                        step_unroll=fam["unroll"])
     core = build_fedcore(
         fam["model"], bench.make_algorithm(fam["algorithm"]), plan, cfg
     )
-    ds = make_synthetic_dataset(
-        seed=0, num_clients=fam["num_clients"], n_local=fam["n_local"],
-        input_shape=tuple(fam["input_shape"]),
-        num_classes=fam["num_classes"], dirichlet_alpha=0.5,
-    ).pad_for(plan, cfg.block_clients).place(plan)
-    state = core.init_state(jax.random.key(0))
-    # Placed exactly as round_step places it (client axis over dp) so the
-    # lowered program's argument shardings match the benchmarked one.
-    from olearning_sim_tpu.parallel.mesh import global_put
-
-    num_steps = global_put(
-        np.full((ds.num_clients,), fam["local_steps"], np.int32),
-        plan.client_sharding(),
-    )
-
+    args = abstract_args(core, fam, plan)
     t0 = time.time()
-    lowered = core._round_step.lower(
-        state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid,
-        ds.weight,
-    )
+    lowered = core._round_step.lower(*args)
     lower_s = time.time() - t0
     t1 = time.time()
     compiled = lowered.compile()
     compile_s = time.time() - t1
-
     mem = compiled.memory_analysis()
-    GB = 1024 ** 3
 
     def gb(x):
         return round(x / GB, 3)
 
-    rec = {
-        "program": (
-            f"headline round_step, {fam['num_clients']} clients x "
-            f"{fam['local_steps']} steps x batch {fam['batch']}, "
-            f"{fam['model']} shapes, block {fam['block']} / "
-            f"unroll {fam['unroll']}"
-        ),
-        "backend": jax.default_backend(),
-        "devices": len(jax.devices()),
+    peak = (mem.argument_size_in_bytes + mem.output_size_in_bytes
+            + mem.temp_size_in_bytes + mem.generated_code_size_in_bytes
+            - mem.alias_size_in_bytes)
+    return {
+        "carry": carry or "f32",
         "lower_sec": round(lower_s, 1),
         "compile_sec": round(compile_s, 1),
         "argument_gb": gb(mem.argument_size_in_bytes),
@@ -90,20 +133,38 @@ def main():
         "temp_gb": gb(mem.temp_size_in_bytes),
         "alias_gb": gb(mem.alias_size_in_bytes),
         "generated_code_gb": gb(mem.generated_code_size_in_bytes),
-        # generated code occupies HBM alongside buffers on TPU targets
-        # (zero on CPU).
-        "peak_estimate_gb": gb(
-            mem.argument_size_in_bytes + mem.output_size_in_bytes
-            + mem.temp_size_in_bytes + mem.generated_code_size_in_bytes
-            - mem.alias_size_in_bytes
-        ),
-        "v5e_hbm_gb": 16,
+        # generated code occupies HBM alongside buffers on TPU targets.
+        "peak_estimate_gb": gb(peak),
+        "fits_v5e_16gb": bool(peak < 16 * GB),
     }
+
+
+def main():
+    import bench
+
+    fam = bench.HEADLINE_FAMILY  # the exact headline configuration
+    device, backend, ndev = get_device()
+    rec = {
+        "program": (
+            f"headline round_step, {fam['num_clients']} clients x "
+            f"{fam['local_steps']} steps x batch {fam['batch']}, "
+            f"{fam['model']} shapes, block {fam['block']} / "
+            f"unroll {fam['unroll']}"
+        ),
+        "backend": backend,
+        "devices": ndev,
+        "v5e_hbm_gb": 16,
+        "programs": {},
+    }
+    for carry in (None, "bf16"):
+        key = "bf16_carry" if carry else "f32_carry"
+        rec["programs"][key] = compile_one(fam, device, carry)
+        print(json.dumps({key: rec["programs"][key]}), flush=True)
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "COMPILE_fullsize.json")
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
-    print(json.dumps(rec))
+    print(json.dumps({k: v for k, v in rec.items() if k != "programs"}))
 
 
 if __name__ == "__main__":
