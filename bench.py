@@ -187,11 +187,31 @@ def select_backend():
     # Mirror an explicit JAX_PLATFORMS into the child's forced platform: a
     # sitecustomize that overrides the env var would otherwise send a
     # user's JAX_PLATFORMS=cpu probe to the (possibly wedged) hardware.
-    backend = probe_backend(
-        dict(os.environ), platform=os.environ.get("JAX_PLATFORMS") or None
-    )
-    if backend is not None:
-        return backend, False
+    #
+    # Retry before degrading: on the axon relay a just-exited process's
+    # device grant can take a while to release, so a probe launched
+    # back-to-back with another bench process's exit can time out in the
+    # claim loop even though the chip is healthy (observed round 4: the
+    # full-suite stage degraded to CPU because its probe raced the
+    # previous stage's grant release).
+    tries = 1 + int(os.environ.get("OLS_BENCH_PROBE_RETRIES", "2"))
+    explicit = os.environ.get("JAX_PLATFORMS") or None
+    for attempt in range(tries):
+        if attempt:
+            time.sleep(int(os.environ.get("OLS_BENCH_PROBE_RETRY_WAIT", "30")))
+        backend = probe_backend(dict(os.environ), platform=explicit)
+        if backend is not None:
+            if explicit:
+                # The probe child honored the explicit platform via a forced
+                # config update — this parent must do the same, or a
+                # sitecustomize that pins the hardware plugin re-routes the
+                # in-process path to the (possibly wedged) device the user
+                # explicitly opted out of (observed: JAX_PLATFORMS=cpu
+                # parent hung in the axon claim loop after its own probe
+                # succeeded on cpu). Children inherit via OLS_FORCE_PLATFORM.
+                os.environ["OLS_FORCE_PLATFORM"] = explicit
+                jax.config.update("jax_platforms", explicit)
+            return backend, False
     # Default path dead (wedged/unavailable accelerator): probe cpu with a
     # forced in-child config update, then adopt it for this process AND
     # every family child (OLS_FORCE_PLATFORM — run_one applies it).

@@ -196,7 +196,14 @@ def main():
         log(f"probe #{state['probes']}: TUNNEL ALIVE (backend={backend}) — "
             f"running {len(pending)} pending stages")
         save_state(state)
-        for name, cmd, timeout_s, env_extra, stdout_to in pending:
+        for i, (name, cmd, timeout_s, env_extra, stdout_to) in enumerate(pending):
+            if i:
+                # Let the previous stage's device grant release before the
+                # next stage's probe runs: back-to-back launches can time
+                # out in the claim loop against a grant the relay hasn't
+                # reaped yet (observed: full_suite degraded to CPU 0s after
+                # headline_bf16 exited).
+                time.sleep(int(os.environ.get("OLS_SENTINEL_SETTLE", "30")))
             ok, note = run_stage(name, cmd, timeout_s, env_extra, stdout_to)
             state["stages"][name] = "done" if ok else "failed"
             state[f"note_{name}"] = note
