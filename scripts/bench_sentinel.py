@@ -20,8 +20,9 @@ Run it in the background from the first minute of the session:
 
 State lives in SENTINEL_state.json (stage -> done/failed + timestamps);
 the log narrates every probe. Artifacts land exactly where the round
-expects them: BENCH_tpu.json, BENCH_suite.json, BENCH_tpu_bf16.json,
-SWEEP.json, COMPILE_fullsize.json, PARITY_convergence_tpu.json.
+expects them: BENCH_tpu.json, BENCH_tpu_bf16.json, BENCH_suite.json
+(merged one family per stage via ``bench.py --family``), SWEEP.json,
+COMPILE_fullsize.json, PARITY_convergence_tpu.json.
 """
 
 import json
@@ -127,15 +128,39 @@ STAGES = [
      [sys.executable, "bench.py"],
      2400, {"OLS_BENCH_FAST": "1", "OLS_BENCH_CARRY": "bf16"},
      "BENCH_tpu_bf16.json"),
-    # 3. Full suite: headline + all five families -> BENCH_suite.json.
-    ("full_suite",
-     [sys.executable, "bench.py"],
-     7200, {}, "BENCH_tpu.json"),
+    # 3a-3e. Breadth suite, ONE FAMILY PER STAGE (VERDICT r4 weak #2: the
+    # monolithic full-suite stage banked nothing when the tunnel died
+    # mid-run; per-family stages mean every heal window banks at least one
+    # family, merged incrementally into BENCH_suite.json). REQUIRE_TPU
+    # makes a degraded run exit rc=3 without writing, so a CPU fallback
+    # never burns the stage — it stays pending for the next heal.
+    ("suite_mlp_1k",
+     [sys.executable, "bench.py", "--family", "fedavg_mnist_mlp_1k"],
+     1800, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
+    ("suite_cnn4_1k",
+     [sys.executable, "bench.py", "--family", "fedavg_cifar10_cnn4_1k"],
+     1800, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
+    ("suite_resnet18_1k",
+     [sys.executable, "bench.py", "--family", "fedprox_femnist_resnet18_1k"],
+     2400, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
+    ("suite_distilbert_1k",
+     [sys.executable, "bench.py", "--family", "fedadam_sent140_distilbert_1k"],
+     2400, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
+    ("suite_vit_1k",
+     [sys.executable, "bench.py", "--family", "ditto_cifar100_vit_tiny_1k"],
+     2400, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
     # 4. Block/unroll sweep for the four never-measured families (weak #2).
     ("sweep_families",
      [sys.executable, "scripts/sweep_families.py", "--untuned"],
      10800, {}, None),
-    # 5. Headline profile: block_unroll probes + HLO cost + trace.
+    # 5c. Packed-client conv lever at headline L1 shapes (verdict #2/#4:
+    # the MXU-ceiling counter-lever — before the profile so a short window
+    # still settles whether packing moves the conv number).
+    ("conv_packed",
+     [sys.executable, "scripts/microbench_conv_packed.py"],
+     3600, {}, None),
+    # 5. Headline profile: block_unroll probes + HLO cost + trace (the
+    # roofline evidence for DESIGN.md's ceiling claim).
     ("profile",
      [sys.executable, "scripts/profile_headline.py", "--quick", "--cost",
       "--trace"],
@@ -143,10 +168,6 @@ STAGES = [
     # 5b. Ring-attention per-step primitive A/B (verdict r3 weak #7).
     ("ring_step",
      [sys.executable, "scripts/bench_ring_step.py"],
-     3600, {}, None),
-    # 5c. Packed-client conv lever at headline L1 shapes (verdict #2).
-    ("conv_packed",
-     [sys.executable, "scripts/microbench_conv_packed.py"],
      3600, {}, None),
     # 6. TPU-lowered full-size memory analysis (verdict #4).
     ("compile_fullsize",
@@ -196,14 +217,15 @@ def main():
         log(f"probe #{state['probes']}: TUNNEL ALIVE (backend={backend}) — "
             f"running {len(pending)} pending stages")
         save_state(state)
-        for i, (name, cmd, timeout_s, env_extra, stdout_to) in enumerate(pending):
-            if i:
-                # Let the previous stage's device grant release before the
-                # next stage's probe runs: back-to-back launches can time
-                # out in the claim loop against a grant the relay hasn't
-                # reaped yet (observed: full_suite degraded to CPU 0s after
-                # headline_bf16 exited).
-                time.sleep(int(os.environ.get("OLS_SENTINEL_SETTLE", "30")))
+        for name, cmd, timeout_s, env_extra, stdout_to in pending:
+            # Let the previous process's device grant release before the
+            # next stage's probe runs: back-to-back launches can time out
+            # in the claim loop against a grant the relay hasn't reaped
+            # yet (observed: full_suite degraded to CPU 0s after
+            # headline_bf16 exited). This applies to the FIRST stage too —
+            # it launches right after the sentinel's own probe subprocess
+            # exits (ADVICE r4 #1).
+            time.sleep(int(os.environ.get("OLS_SENTINEL_SETTLE", "30")))
             ok, note = run_stage(name, cmd, timeout_s, env_extra, stdout_to)
             state["stages"][name] = "done" if ok else "failed"
             state[f"note_{name}"] = note
