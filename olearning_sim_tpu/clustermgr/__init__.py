@@ -20,6 +20,11 @@ from olearning_sim_tpu.clustermgr.launcher import (
     MultiHostLauncher,
     initialize_distributed,
 )
+from olearning_sim_tpu.clustermgr.k8s_api import (
+    K8sClusterManager,
+    TpuPodJobApi,
+    TpuPodJobBuilder,
+)
 
 __all__ = [
     "ClusterManager",
@@ -28,4 +33,7 @@ __all__ = [
     "DistributedConfig",
     "MultiHostLauncher",
     "initialize_distributed",
+    "K8sClusterManager",
+    "TpuPodJobApi",
+    "TpuPodJobBuilder",
 ]
