@@ -1,4 +1,10 @@
-from olearning_sim_tpu.utils.repo import MemoryTableRepo, SqliteTableRepo, TableRepo
+from olearning_sim_tpu.utils.repo import (
+    MemoryTableRepo,
+    MySqlTableRepo,
+    SqliteTableRepo,
+    TableRepo,
+)
 from olearning_sim_tpu.utils.logging import Logger
 
-__all__ = ["Logger", "MemoryTableRepo", "SqliteTableRepo", "TableRepo"]
+__all__ = ["Logger", "MemoryTableRepo", "MySqlTableRepo", "SqliteTableRepo",
+           "TableRepo"]

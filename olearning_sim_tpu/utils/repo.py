@@ -8,8 +8,12 @@ implementations:
 
 - :class:`MemoryTableRepo` — dict-backed, for single-process mode and tests;
 - :class:`SqliteTableRepo` — stdlib sqlite3 file DB for durable single-host
-  deployments (crash recovery semantics, SURVEY.md section 5); a MySQL-backed
-  implementation can slot in behind the same interface for cluster mode.
+  deployments (crash recovery semantics, SURVEY.md section 5);
+- :class:`MySqlTableRepo` — the cluster-mode shared state bus the reference
+  runs on (``repo_utils.py``'s ``mysql+pymysql`` engine), as a DBAPI
+  adapter with the reference's reconnect-once-then-retry discipline.
+  Import-gated: the driver module (pymysql) loads only on the production
+  path; tests inject sqlite3 connections through the same adapter code.
 
 All values are stored as TEXT (the reference serializes JSON into MySQL text
 columns the same way); typed access is the caller's concern.
@@ -210,3 +214,159 @@ class SqliteTableRepo(TableRepo):
             cur = self._conn.execute(f"SELECT {', '.join(self.columns)} FROM {self.table}")
             rows = cur.fetchall()
         return [dict(zip(self.columns, r)) for r in rows]
+
+
+class MySqlTableRepo(TableRepo):
+    """MySQL-backed repo over any DBAPI-2.0 connection.
+
+    The reference's shared control-plane bus is MySQL behind SQLAlchemy
+    (``ols_core/utils/repo_utils.py:31-36`` builds a ``mysql+pymysql``
+    engine; every accessor catches OperationalError, re-initializes the
+    connection ONCE, and retries — ``:49-56``, ``:89-104``). This adapter
+    keeps that exact discipline over a plain DBAPI driver (no SQLAlchemy
+    in this image): ``connect`` is a zero-arg factory returning a fresh
+    connection, every operation retries once through a fresh connection on
+    failure, and errors degrade to False/None/[] rather than raising (the
+    reference's posture — callers poll).
+
+    ``paramstyle``: "format" for pymysql/mysql-connector (%s), "qmark"
+    for DBAPI drivers like sqlite3 (?) — which is also how the adapter's
+    SQL generation and retry logic stay testable without a MySQL server.
+    """
+
+    def __init__(self, connect, table: str, columns: Sequence[str],
+                 paramstyle: str = "format"):
+        if not table.isidentifier():
+            raise ValueError(f"invalid table name {table!r}")
+        for c in columns:
+            if not c.isidentifier():
+                raise ValueError(f"invalid column name {c!r}")
+        if paramstyle not in ("format", "qmark"):
+            raise ValueError(f"unsupported paramstyle {paramstyle!r}")
+        self.table = table
+        self.columns = list(columns)
+        self._connect = connect
+        self._ph = "%s" if paramstyle == "format" else "?"
+        self._lock = threading.RLock()
+        self._conn = connect()
+
+    @classmethod
+    def from_config(cls, host: str, port: int, user: str, password: str,
+                    database: str, table: str, columns: Sequence[str]):
+        """Production constructor (reference ``SqlDataBase.__init__`` reads
+        the same fields from table YAMLs, ``repo_utils.py:20-29``).
+        Import-gated on pymysql."""
+        import pymysql  # noqa: PLC0415 — only the MySQL path needs it
+
+        def connect():
+            return pymysql.connect(host=host, port=int(port), user=user,
+                                   password=password, database=database,
+                                   autocommit=False)
+
+        return cls(connect, table, columns, paramstyle="format")
+
+    def _col(self, name: str) -> str:
+        if name not in self.columns:
+            raise KeyError(f"unknown column {name!r} for table {self.table}")
+        return name
+
+    def _execute(self, sql: str, params: Sequence[Any]):
+        """Run one statement; on ANY connection/driver error, reconnect once
+        and retry (reference ``:49-56``). Raises only if the retry fails too
+        — callers translate that into their False/None returns."""
+        cur = self._execute_batch(sql, [tuple(params)])
+        return cur
+
+    def _execute_batch(self, sql: str, rows: Sequence[Sequence[Any]]):
+        """Run one statement over many param rows in a SINGLE transaction
+        (all rows, then one commit — same all-or-nothing semantics as
+        SqliteTableRepo's add_item). On failure: roll back, reconnect once,
+        retry the WHOLE batch; a second failure rolls back and raises, so a
+        partial prefix is never left committed for the caller to re-insert."""
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    cur = self._conn.cursor()
+                    for row in rows:
+                        cur.execute(sql, tuple(row))
+                    self._conn.commit()
+                    return cur
+                except Exception:  # noqa: BLE001 — DBAPI error bases vary by driver
+                    try:
+                        self._conn.rollback()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    if attempt:
+                        raise
+                    try:
+                        self._conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._conn = self._connect()
+
+    def add_item(self, item: Dict[str, List[Any]]) -> bool:
+        try:
+            keys = [self._col(k) for k in item]
+            lengths = {len(v) for v in item.values()}
+            if len(lengths) > 1:
+                return False
+            n = lengths.pop() if lengths else 0
+            placeholders = ", ".join(self._ph for _ in keys)
+            sql = (f"INSERT INTO {self.table} ({', '.join(keys)}) "
+                   f"VALUES ({placeholders})")
+            self._execute_batch(sql, [[item[k][i] for k in keys]
+                                      for i in range(n)])
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def get_item_value(self, identify_name, identify_value, item):
+        try:
+            sql = (f"SELECT {self._col(item)} FROM {self.table} "
+                   f"WHERE {self._col(identify_name)} = {self._ph} LIMIT 1")
+            row = self._execute(sql, (identify_value,)).fetchone()
+            return row[0] if row else None
+        except Exception:  # noqa: BLE001
+            return None
+
+    def set_item_value(self, identify_name, identify_value, item, value) -> bool:
+        try:
+            sql = (f"UPDATE {self.table} SET {self._col(item)} = {self._ph} "
+                   f"WHERE {self._col(identify_name)} = {self._ph}")
+            return self._execute(sql, (value, identify_value)).rowcount > 0
+        except Exception:  # noqa: BLE001
+            return False
+
+    def delete_items(self, **conditions) -> bool:
+        try:
+            clause = " AND ".join(
+                f"{self._col(k)} = {self._ph}" for k in conditions
+            )
+            sql = f"DELETE FROM {self.table}" + (
+                f" WHERE {clause}" if clause else ""
+            )
+            return self._execute(sql, list(conditions.values())).rowcount > 0
+        except Exception:  # noqa: BLE001
+            return False
+
+    def get_values_by_conditions(self, item, **conditions):
+        try:
+            clause = " AND ".join(
+                f"{self._col(k)} = {self._ph}" for k in conditions
+            )
+            sql = f"SELECT {self._col(item)} FROM {self.table}" + (
+                f" WHERE {clause}" if clause else ""
+            )
+            return [r[0] for r in self._execute(
+                sql, list(conditions.values())).fetchall()]
+        except Exception:  # noqa: BLE001
+            return []
+
+    def query_all(self):
+        try:
+            cur = self._execute(
+                f"SELECT {', '.join(self.columns)} FROM {self.table}", ()
+            )
+            return [dict(zip(self.columns, r)) for r in cur.fetchall()]
+        except Exception:  # noqa: BLE001
+            return []
