@@ -11,8 +11,10 @@ with a +1 rotation lays onto the torus).
 
 Per-step local attention is either plain XLA ops (the default — measured
 faster single-chip, see ``ops/flash_attention.py``) or the fused Pallas
-kernel (``use_flash=True``; per-chunk scores stay in VMEM; forward-only).
-``scripts/bench_ring_step.py`` measures the two at ring-chunk shapes.
+kernel (``use_flash=True``; per-chunk scores stay in VMEM; trainable —
+the kernel carries a custom VJP that rematerializes the backward through
+XLA). ``scripts/bench_ring_step.py`` measures the two at ring-chunk
+shapes.
 
 Usage requires being inside ``shard_map`` with the sequence axis sharded
 over ``axis_name`` — see ``ring_self_attention`` for the module-level entry.
@@ -55,9 +57,10 @@ def ring_attention(
       kv_mask: [B, Lc] bool, True = real key; None = no padding.
       use_flash: compute each ring step's local attention with the fused
         Pallas kernel (``ops.flash_attention_stats``) instead of plain XLA
-        ops. FORWARD-ONLY (the kernel has no VJP) and default OFF: XLA's
-        fused dense attention measured faster at every single-chip length
-        tried (see ``ops/flash_attention.py``); flip the default only if
+        ops. Trainable (the kernel carries a custom VJP whose backward
+        rematerializes through XLA) but default OFF: XLA's fused dense
+        attention measured faster at every single-chip length tried (see
+        ``ops/flash_attention.py``); flip the default only if
         ``scripts/bench_ring_step.py`` shows the kernel winning at your
         chunk shapes.
     Returns [B, H, Lc, D] — the local queries' attention over the GLOBAL
@@ -152,7 +155,7 @@ class RingSelfAttention(nn.Module):
     num_heads: int
     axis_name: str = "sp"
     dtype: jnp.dtype = jnp.bfloat16
-    use_flash: bool = False  # forward-only; see ring_attention(use_flash=)
+    use_flash: bool = False  # see ring_attention(use_flash=); trainable
 
     @nn.compact
     def __call__(self, x: jax.Array, pad_mask: jax.Array) -> jax.Array:
