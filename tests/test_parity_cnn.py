@@ -169,12 +169,21 @@ def test_carry_artifact_matches_f32_artifact():
     if f32["rounds"] < 30 or bf16["rounds"] < 30:
         pytest.skip("artifact regeneration in progress")
     assert bf16.get("carry") == "bf16"
-    # Compare at the last COMMON evaluated round: the two runs may have
-    # been cut at different lengths, and a length mismatch must not hide
-    # (or fake) a carry-numerics difference.
+    # Matched-rounds A/B (VERDICT r4 weak #3: the round-3/4 artifacts were
+    # 45-vs-40 rounds and compared only endpoints): the runs must be the
+    # same length, and the WHOLE curve past the warmup must track — a
+    # carry-numerics divergence that recovers by the final round must not
+    # hide behind an endpoint-only check.
+    if bf16["rounds"] < f32["rounds"]:
+        pytest.skip("matched-rounds bf16 regeneration in progress "
+                    f"({bf16['rounds']}/{f32['rounds']})")
+    assert bf16["rounds"] == f32["rounds"], (bf16["rounds"], f32["rounds"])
     f32_by_round = {c["round"]: c["acc_engine"] for c in f32["curves"]}
     common = [c["round"] for c in bf16["curves"] if c["round"] in f32_by_round]
     assert common and max(common) >= 30, (common, "no common round >= 30")
-    r = max(common)
-    bf16_acc = {c["round"]: c["acc_engine"] for c in bf16["curves"]}[r]
-    assert abs(bf16_acc - f32_by_round[r]) <= 0.003, (r, bf16_acc, f32_by_round[r])
+    bf16_by_round = {c["round"]: c["acc_engine"] for c in bf16["curves"]}
+    deltas = {r: abs(bf16_by_round[r] - f32_by_round[r])
+              for r in common if r > 10}
+    assert deltas, "no common evaluated rounds past warmup (r > 10)"
+    bad = {r: d for r, d in deltas.items() if d > 0.003}
+    assert not bad, f"bf16-carry curve diverges past round 10: {bad}"
