@@ -149,13 +149,11 @@ STAGES = [
     ("suite_vit_1k",
      [sys.executable, "bench.py", "--family", "ditto_cifar100_vit_tiny_1k"],
      2400, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
-    # 4. Block/unroll sweep for the four never-measured families (weak #2).
-    ("sweep_families",
-     [sys.executable, "scripts/sweep_families.py", "--untuned"],
-     10800, {}, None),
-    # 5c. Packed-client conv lever at headline L1 shapes (verdict #2/#4:
-    # the MXU-ceiling counter-lever — before the profile so a short window
-    # still settles whether packing moves the conv number).
+    # Cheap-first after the suite: observed heal windows are SHORT (~6 min
+    # in round 4), so the 15-min microbench and profile — the MXU-ceiling
+    # evidence (verdict #4) — run before the multi-hour sweep can eat a
+    # window.
+    # 5c. Packed-client conv lever (+K/C pad variants) at headline L1 shapes.
     ("conv_packed",
      [sys.executable, "scripts/microbench_conv_packed.py"],
      3600, {}, None),
@@ -169,7 +167,13 @@ STAGES = [
     ("ring_step",
      [sys.executable, "scripts/bench_ring_step.py"],
      3600, {}, None),
-    # 6. TPU-lowered full-size memory analysis (verdict #4).
+    # 4. Block/unroll sweep for the four never-measured families (weak #2).
+    ("sweep_families",
+     [sys.executable, "scripts/sweep_families.py", "--untuned"],
+     7200, {}, None),
+    # 6. TPU-lowered full-size memory analysis: banked round 5 via v5e
+    # topology AOT (no grant needed); kept as a stage so a live-chip
+    # confirmation lands if a long window allows, after everything else.
     ("compile_fullsize",
      [sys.executable, "scripts/compile_fullsize.py"],
      3600, {}, None),

@@ -122,6 +122,29 @@ def test_convergence_artifact_within_baseline_bound():
     assert abs(rec["final_acc_engine"] - rec["final_acc_oracle"]) <= 0.003, rec
 
 
+def test_hard_regime_convergence_artifact_tracks_oracle():
+    """The HARD-regime record (class_sep 0.35 — VERDICT r4 #3: the
+    saturated 99.6% regime compresses deltas to zero, so the bound must
+    also hold where the landscape is difficult): engine-vs-oracle deltas
+    within the BASELINE bound at EVERY evaluated round, not just the
+    endpoint — in a non-saturated regime the whole curve is informative."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "PARITY_convergence_hard.json")
+    if not os.path.exists(path):
+        pytest.skip("hard-regime convergence artifact not generated yet")
+    with open(path) as f:
+        rec = json.load(f)
+    if rec["rounds"] < 30:
+        pytest.skip(f"artifact regeneration in progress ({rec['rounds']} rounds)")
+    assert rec["num_clients"] >= 1000
+    assert rec["class_sep"] <= 0.5  # genuinely the hard regime
+    deltas = {c["round"]: abs(c["acc_engine"] - c["acc_oracle"])
+              for c in rec["curves"] if c["acc_oracle"] is not None}
+    assert deltas, "no oracle-evaluated rounds in the artifact"
+    bad = {r: round(d, 4) for r, d in deltas.items() if d > 0.003}
+    assert not bad, f"engine-vs-oracle divergence in the hard regime: {bad}"
+
+
 def test_bf16_carry_parity():
     """The bf16 local-SGD carry (FedCoreConfig.carry_dtype — a measured-on-
     TPU perf lever) must stay within the accuracy-parity envelope: same
