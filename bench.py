@@ -139,7 +139,7 @@ PROBE_TIMEOUT_S = int(os.environ.get("OLS_BENCH_PROBE_TIMEOUT", "300"))
 # Retry probes run under a shorter leash: the first probe already waited
 # out the claim loop once, so retries only need to cover a grant-release
 # race, not a cold wedge. Worst-case degrade latency with defaults:
-# 300 + 2*(30 sleep + 120) = 600 s before the CPU fallback probe.
+# 300 + 1*(30 sleep + 120) = 450 s before the CPU fallback probe.
 RETRY_PROBE_TIMEOUT_S = int(os.environ.get("OLS_BENCH_RETRY_PROBE_TIMEOUT",
                                            "120"))
 
@@ -202,7 +202,7 @@ def select_backend():
     # claim loop even though the chip is healthy (observed round 4: the
     # full-suite stage degraded to CPU because its probe raced the
     # previous stage's grant release).
-    tries = 1 + int(os.environ.get("OLS_BENCH_PROBE_RETRIES", "2"))
+    tries = 1 + int(os.environ.get("OLS_BENCH_PROBE_RETRIES", "1"))
     explicit = os.environ.get("JAX_PLATFORMS") or None
     for attempt in range(tries):
         if attempt:
@@ -255,7 +255,12 @@ HEADLINE_TIMEOUT_S = int(os.environ.get("OLS_BENCH_HEADLINE_TIMEOUT", "1800"))
 # even after worst-case probe latency (~10 min).
 _T0 = time.monotonic()
 TOTAL_BUDGET_S = int(os.environ.get("OLS_BENCH_TOTAL_BUDGET", "3300"))
-DEGRADED_BUDGET_S = int(os.environ.get("OLS_BENCH_DEGRADED_BUDGET", "2100"))
+# Rehearsed round 5 under worst-case load (a convergence run owning the
+# other half of the single core): probes 600 s + degraded headline 370 s +
+# 3 families ≈ 2400 s wall at budget 2100 — rc=0 with the last two
+# families shed. 1500 keeps worst-case wall under ~1900 s while an
+# uncontended degraded run (~1300 s) still banks all five families.
+DEGRADED_BUDGET_S = int(os.environ.get("OLS_BENCH_DEGRADED_BUDGET", "1500"))
 
 
 def _remaining(budget_s):
