@@ -109,6 +109,48 @@ def test_merge_survives_corrupt_suite_file(bench, tmp_path):
         assert json.load(f)[0]["family"] == "a"
 
 
+def test_family_mode_requires_tpu_exits_3_without_writing(bench, tmp_path,
+                                                          monkeypatch):
+    """The per-family sentinel stage contract: a degraded backend under
+    OLS_BENCH_REQUIRE_TPU=1 exits rc=3 and banks NOTHING, so the stage
+    stays pending for the next heal instead of burning itself on a CPU
+    fallback."""
+    monkeypatch.setattr(bench, "select_backend", lambda: ("cpu", True))
+    monkeypatch.setenv("OLS_BENCH_REQUIRE_TPU", "1")
+    wrote = []
+    monkeypatch.setattr(bench, "_merge_suite", lambda rec, path=None:
+                        wrote.append(rec))
+    with pytest.raises(SystemExit) as exc:
+        bench.run_family_once("fedavg_mnist_mlp_1k")
+    assert exc.value.code == 3
+    assert wrote == []
+
+
+def test_family_mode_banks_with_provenance(bench, monkeypatch, capsys):
+    """A healthy --family run measures one family and merges it with
+    provenance fields attached."""
+    monkeypatch.setattr(bench, "select_backend", lambda: ("tpu", False))
+    monkeypatch.delenv("OLS_BENCH_REQUIRE_TPU", raising=False)
+    monkeypatch.delenv("OLS_BENCH_CARRY", raising=False)
+    monkeypatch.setattr(bench, "_isolate", lambda: False)
+    monkeypatch.setattr(bench, "make_mesh_plan", lambda: None)
+    monkeypatch.setattr(
+        bench, "run_one_inprocess",
+        lambda plan, fam: {"family": fam["name"], "rounds_per_sec": 2.5,
+                           "backend": "tpu"},
+    )
+    wrote = []
+    monkeypatch.setattr(bench, "_merge_suite", lambda rec, path=None:
+                        wrote.append(rec))
+    bench.run_family_once("fedavg_mnist_mlp_1k")
+    assert len(wrote) == 1
+    rec = wrote[0]
+    assert rec["backend"] == "tpu"
+    assert rec["degraded"] is False
+    assert rec["nominal_clients"] == 1000
+    assert json.loads(capsys.readouterr().out.strip())["rounds_per_sec"] == 2.5
+
+
 def test_budget_accounting(bench, monkeypatch):
     """_remaining counts down from import time against the given budget;
     the degraded budget leaves the headline plus probes comfortable room
