@@ -104,6 +104,13 @@ def compile_one(fam, device, carry=None):
         block_clients=fam["block"], step_unroll=fam["unroll"],
         carry_dtype=jnp.bfloat16 if carry == "bf16" else None,
     )
+    from olearning_sim_tpu.parallel.mesh import shard_clients
+
+    # Blocks per device of the compiled scan — from the SAME padding
+    # arithmetic that shapes the program's arguments (abstract_args), so
+    # the FLOP multiplier can't drift from what actually runs.
+    padded, _ = shard_clients(fam["num_clients"], plan, fam["block"])
+    num_blocks = padded // (fam["block"] * plan.dp)
     import bench
 
     core = build_fedcore(
@@ -117,6 +124,15 @@ def compile_one(fam, device, carry=None):
     compiled = lowered.compile()
     compile_s = time.time() - t1
     mem = compiled.memory_analysis()
+    # TPU-lowered FLOP/byte counts for the roofline (DESIGN.md §2): the
+    # compiler's own accounting of the optimized executable, replacing the
+    # analytic per-layer estimate. Available from the same topology-AOT
+    # compile that needs no device grant.
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    ca = ca if isinstance(ca, dict) else {}
+    flops = ca.get("flops")  # None (not 0.0) when the backend omits it
 
     def gb(x):
         return round(x / GB, 3)
@@ -136,6 +152,21 @@ def compile_one(fam, device, carry=None):
         # generated code occupies HBM alongside buffers on TPU targets.
         "peak_estimate_gb": gb(peak),
         "fits_v5e_16gb": bool(peak < 16 * GB),
+        # XLA cost analysis counts ONE iteration of the outer client-block
+        # scan (whose body contains the fully-unrolled 10-step inner
+        # loop): flops * num_blocks is the whole round. Cross-check: the
+        # 43.5 GF body ~= 16 clients x 20 samples x 10 steps x 13.6
+        # MF/sample-step (fwd+bwd ~= 2.64x fwd) — compiler-grade
+        # confirmation of DESIGN.md §2's analytic roofline. null = the
+        # backend produced no cost analysis (distinct from a measured 0).
+        "cost_flops_scan_body": None if flops is None else float(flops),
+        "cost_bytes_accessed_scan_body_gb": (
+            None if "bytes accessed" not in ca
+            else gb(float(ca["bytes accessed"]))),
+        "num_client_blocks": num_blocks,
+        "cost_tflops_per_round": (
+            None if flops is None
+            else round(float(flops) * num_blocks / 1e12, 1)),
     }
 
 
