@@ -1,0 +1,53 @@
+"""Wedge-proof TPU gate for standalone capture scripts.
+
+bench.py probes the backend in a subprocess before its parent process
+ever initializes JAX (a wedged axon tunnel hangs any in-process backend
+touch forever — the round-2 lesson). The other sentinel stages
+(sweep_families, profile_headline, bench_ring_step,
+microbench_conv_packed, convergence_parity --backend tpu) import jax
+directly, so a stage launched into a re-wedged tunnel would burn its
+whole sentinel timeout doing nothing (ADVICE r4 #1 flagged exactly
+this). :func:`require_tpu_if_asked` runs the same subprocess probe FIRST
+and exits rc=3 — the sentinel's "stage stays pending, retry next heal"
+code — when the sentinel (via ``OLS_BENCH_REQUIRE_TPU=1``) demands real
+hardware and the probe can't reach it. Manual runs without the env var
+are untouched (CPU numerics checks stay possible).
+"""
+
+import os
+import subprocess
+import sys
+
+_PROBE_SRC = (
+    "import jax\n"
+    "x = jax.numpy.ones((8, 8))\n"
+    "float((x @ x).sum())\n"
+    "print('GUARD_PROBE_OK', jax.default_backend(), flush=True)\n"
+)
+
+
+def require_tpu_if_asked(timeout_s: int = 240) -> None:
+    """Exit rc=3 unless a subprocess probe reaches a TPU backend.
+
+    No-op unless ``OLS_BENCH_REQUIRE_TPU=1``. Call BEFORE importing jax
+    in the script's own process. Guards the stage's START only — a
+    mid-run wedge is still bounded by the sentinel's stage timeout."""
+    if os.environ.get("OLS_BENCH_REQUIRE_TPU") != "1":
+        return
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC], timeout=timeout_s,
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("tpu guard: probe timed out (tunnel wedged); exiting 3 so the "
+              "sentinel retries this stage on the next heal", file=sys.stderr)
+        sys.exit(3)
+    backend = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("GUARD_PROBE_OK"):
+            backend = line.split()[1]
+    if backend != "tpu":
+        print(f"tpu guard: probe reached backend={backend!r}, not tpu; "
+              "exiting 3", file=sys.stderr)
+        sys.exit(3)

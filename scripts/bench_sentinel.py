@@ -38,15 +38,14 @@ STATE_PATH = os.path.join(REPO, "SENTINEL_state.json")
 PROBE_TIMEOUT_S = int(os.environ.get("OLS_SENTINEL_PROBE_TIMEOUT", "120"))
 PROBE_INTERVAL_S = int(os.environ.get("OLS_SENTINEL_PROBE_INTERVAL", "180"))
 
-# A tiny op through the default (hardware) platform. Mirrors
-# bench.probe_backend but standalone so the sentinel has no import-time
-# JAX dependency in the parent process.
-_PROBE_SRC = (
-    "import jax\n"
-    "x = jax.numpy.ones((8, 8))\n"
-    "float((x @ x).sum())\n"
-    "print('SENTINEL_PROBE_OK', jax.default_backend(), flush=True)\n"
-)
+# A tiny op through the default (hardware) platform, shared with the
+# per-stage guard (scripts/_tpu_guard.py) — both are jax-free in the
+# parent process; bench.py keeps its own copy because it imports jax at
+# module top for the measurement path.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _tpu_guard import _PROBE_SRC  # noqa: E402
+
+_PROBE_MARKER = "GUARD_PROBE_OK"
 
 
 def log(msg):
@@ -64,7 +63,7 @@ def probe():
     except subprocess.TimeoutExpired:
         return None
     for line in proc.stdout.splitlines():
-        if line.startswith("SENTINEL_PROBE_OK"):
+        if line.startswith(_PROBE_MARKER):
             return line.split()[1]
     return None
 
@@ -156,21 +155,21 @@ STAGES = [
     # 5c. Packed-client conv lever (+K/C pad variants) at headline L1 shapes.
     ("conv_packed",
      [sys.executable, "scripts/microbench_conv_packed.py"],
-     3600, {}, None),
+     3600, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
     # 5. Headline profile: block_unroll probes + HLO cost + trace (the
     # roofline evidence for DESIGN.md's ceiling claim).
     ("profile",
      [sys.executable, "scripts/profile_headline.py", "--quick", "--cost",
       "--trace"],
-     3600, {}, None),
+     3600, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
     # 5b. Ring-attention per-step primitive A/B (verdict r3 weak #7).
     ("ring_step",
      [sys.executable, "scripts/bench_ring_step.py"],
-     3600, {}, None),
+     3600, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
     # 4. Block/unroll sweep for the four never-measured families (weak #2).
     ("sweep_families",
      [sys.executable, "scripts/sweep_families.py", "--untuned"],
-     7200, {}, None),
+     7200, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
     # 6. TPU-lowered full-size memory analysis: banked round 5 via v5e
     # topology AOT (no grant needed); kept as a stage so a live-chip
     # confirmation lands if a long window allows, after everything else.
@@ -182,7 +181,7 @@ STAGES = [
      [sys.executable, "scripts/convergence_parity.py", "--backend", "tpu",
       "--class-sep", "0.35", "--rounds", "40",
       "--out", "PARITY_convergence_tpu.json"],
-     10800, {}, None),
+     10800, {"OLS_BENCH_REQUIRE_TPU": "1"}, None),
 ]
 
 

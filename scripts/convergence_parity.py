@@ -31,6 +31,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
 ))
+import _tpu_guard  # script dir is on sys.path when run as a script
+_tpu_guard.require_tpu_if_asked()
+
 
 _ap = argparse.ArgumentParser()
 _ap.add_argument("--class-sep", type=float,

@@ -47,6 +47,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _tpu_guard  # script dir is on sys.path when run as a script
+_tpu_guard.require_tpu_if_asked()
+
 
 import jax
 

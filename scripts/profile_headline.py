@@ -10,10 +10,14 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _tpu_guard  # script dir is on sys.path when run as a script
+# BEFORE import jax: backend/plugin discovery against a wedged tunnel can
+# hang in-process, which is exactly what the subprocess probe prevents.
+_tpu_guard.require_tpu_if_asked()
+
 import jax
 import numpy as np
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
 from olearning_sim_tpu.engine.fedcore import FedCoreConfig
