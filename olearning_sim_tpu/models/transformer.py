@@ -29,6 +29,11 @@ class TransformerBlock(nn.Module):
     mlp_dim: int
     dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "dense"
+    # Ring-only: compute each ring step with the fused Pallas kernel
+    # (trainable via its custom VJP) instead of plain XLA ops — the
+    # per-chunk A/B switch of ops/flash_attention.py, exposed at model
+    # level so configs can flip it without code.
+    ring_use_flash: bool = False
 
     @nn.compact
     def __call__(self, x, pad_mask):
@@ -48,6 +53,7 @@ class TransformerBlock(nn.Module):
             # eval of a model trained with attention_impl="dense").
             y = RingSelfAttention(
                 num_heads=self.heads, dtype=self.dtype,
+                use_flash=self.ring_use_flash,
                 name="MultiHeadDotProductAttention_0",
             )(x, pad_mask)
         elif self.attention_impl == "flash":
@@ -90,6 +96,7 @@ class TextTransformer(nn.Module):
     pad_id: int = 0
     dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "dense"
+    ring_use_flash: bool = False  # see TransformerBlock.ring_use_flash
 
     @nn.compact
     def __call__(self, tokens):
@@ -124,7 +131,7 @@ class TextTransformer(nn.Module):
         for _ in range(self.depth):
             x = TransformerBlock(
                 self.width, self.heads, self.mlp_dim, self.dtype,
-                self.attention_impl,
+                self.attention_impl, self.ring_use_flash,
             )(x, pad_mask)
         # Mean-pool over real tokens (robust when no CLS convention exists in
         # the synthetic/Sent140 tokenization).

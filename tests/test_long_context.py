@@ -18,14 +18,16 @@ OVERRIDES = dict(vocab_size=96, max_len=32, width=32, depth=2, heads=4,
                  mlp_dim=64, num_classes=3)
 
 
-def build_pair():
+def build_pair(**ring_extra):
     spec = get_model("distilbert")
     dense = spec.build(**OVERRIDES)
-    ring = spec.build(**OVERRIDES, attention_impl="ring")
+    ring = spec.build(**OVERRIDES, attention_impl="ring", **ring_extra)
     tokens = np.array(
         jax.random.randint(jax.random.key(1), (8, 32), 1, 96), np.int32
     )
-    # pad tail of some rows to exercise masking across chunks
+    # pad tail of some rows to exercise masking across chunks (with sp=4
+    # the chunks are 8 tokens: row 2's padding starts mid-chunk-2, row 5's
+    # mid-chunk-1, so partially-masked K/V chunks are always in play)
     tokens[2, 20:] = 0
     tokens[5, 9:] = 0
     params = dense.init(jax.random.key(0), tokens[:1])["params"]
@@ -38,6 +40,28 @@ def test_ring_params_compatible_and_match_dense():
     ref = dense.apply({"params": params}, tokens)
     got = np.asarray(sp_forward(ring, params, tokens, plan))
     np.testing.assert_allclose(np.asarray(ref), got, atol=2e-2, rtol=2e-2)
+
+
+def test_model_level_ring_use_flash_matches_dense():
+    """The ring_use_flash model flag routes per-step attention through the
+    Pallas stats kernel (custom VJP); same params, same outputs (including
+    build_pair's partially-masked K/V chunks), and a train step through it
+    stays finite — the model-level surface of the ops-level A/B
+    (tests/test_ops.py)."""
+    import optax
+
+    from olearning_sim_tpu.parallel.long_context import sp_train_step
+
+    dense, ring_flash, params, tokens = build_pair(ring_use_flash=True)
+    plan = make_mesh_plan(dp=2, mp=1, sp=4)
+    ref = np.asarray(dense.apply({"params": params}, tokens))
+    got = np.asarray(sp_forward(ring_flash, params, tokens, plan))
+    np.testing.assert_allclose(ref, got, atol=2e-2, rtol=2e-2)
+    labels = np.asarray(tokens[:, 0] % 3, np.int32)
+    opt = optax.sgd(0.05)
+    _, _, loss = sp_train_step(ring_flash, params, jax.jit(opt.init)(params),
+                               tokens, labels, opt, plan)
+    assert np.isfinite(float(loss))
 
 
 def test_sp_evaluate_matches_dense_eval():
