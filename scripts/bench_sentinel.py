@@ -199,9 +199,30 @@ def save_state(state):
 
 def main():
     state = load_state()
+    # Hard exit deadline (unix seconds): the driver's end-of-round bench.py
+    # probes the same single-chip grant — a multi-hour sentinel stage still
+    # holding it at that moment would degrade the OFFICIAL capture to CPU
+    # on a perfectly healthy tunnel. Set OLS_SENTINEL_EXIT_AT comfortably
+    # before round end; no stage is started that could overrun it.
+    try:
+        exit_at = float(os.environ.get("OLS_SENTINEL_EXIT_AT", "0") or 0)
+    except ValueError:
+        # A malformed deadline must not kill the whole campaign; run
+        # undeadlined and say so loudly.
+        log(f"OLS_SENTINEL_EXIT_AT={os.environ['OLS_SENTINEL_EXIT_AT']!r} "
+            "is not unix seconds; ignoring the exit deadline")
+        exit_at = 0.0
     log(f"sentinel up; {len(STAGES)} stages, "
-        f"probe every {PROBE_INTERVAL_S}s (timeout {PROBE_TIMEOUT_S}s)")
+        f"probe every {PROBE_INTERVAL_S}s (timeout {PROBE_TIMEOUT_S}s)"
+        + (f", exit at unix {exit_at:.0f}" if exit_at else ""))
     while True:
+        # The probe subprocess itself holds the device grant for up to
+        # PROBE_TIMEOUT_S — it must finish before the deadline too, or the
+        # driver's official capture can stall against our grant.
+        if exit_at and time.time() + PROBE_TIMEOUT_S >= exit_at:
+            log("exit deadline reached — leaving the chip free for the "
+                "driver's official capture; exiting")
+            return
         pending = [s for s in STAGES if state["stages"].get(s[0]) != "done"]
         if not pending:
             log("campaign complete — all stages done; exiting")
@@ -220,7 +241,12 @@ def main():
         log(f"probe #{state['probes']}: TUNNEL ALIVE (backend={backend}) — "
             f"running {len(pending)} pending stages")
         save_state(state)
+        settle = int(os.environ.get("OLS_SENTINEL_SETTLE", "30"))
         for name, cmd, timeout_s, env_extra, stdout_to in pending:
+            if exit_at and time.time() + settle + timeout_s > exit_at:
+                log(f"stage {name}: would overrun the exit deadline "
+                    f"(needs {settle}+{timeout_s}s); leaving pending")
+                continue
             # Let the previous process's device grant release before the
             # next stage's probe runs: back-to-back launches can time out
             # in the claim loop against a grant the relay hasn't reaped
@@ -228,7 +254,7 @@ def main():
             # headline_bf16 exited). This applies to the FIRST stage too —
             # it launches right after the sentinel's own probe subprocess
             # exits (ADVICE r4 #1).
-            time.sleep(int(os.environ.get("OLS_SENTINEL_SETTLE", "30")))
+            time.sleep(settle)
             ok, note = run_stage(name, cmd, timeout_s, env_extra, stdout_to)
             state["stages"][name] = "done" if ok else "failed"
             state[f"note_{name}"] = note
