@@ -17,6 +17,7 @@ contract (subdomain == headless service name, rank from completion index).
 """
 
 import glob
+import json
 import os
 
 import jsonschema
@@ -327,6 +328,50 @@ def test_services_select_existing_pod_labels_and_ports():
                 assert tp in names, (
                     f"{fname}: targetPort {tp!r} names no container port "
                     f"({names})"
+                )
+
+
+def test_dockerfile_paths_and_entrypoints_exist():
+    """deploy/Dockerfile builds the image every manifest references; no
+    docker daemon exists here, so validate structurally: every COPY source
+    is a real repo path, the CMD module/config exist, and the engine Job's
+    command script is among the copied files — a renamed script or config
+    can't silently break the (unbuildable-here) image."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    dockerfile = os.path.join(repo, "deploy", "Dockerfile")
+    assert os.path.exists(dockerfile), "deploy/Dockerfile missing"
+    with open(dockerfile) as f:
+        lines = [ln.strip() for ln in f if ln.strip()
+                 and not ln.strip().startswith("#")]
+
+    # COPY <src>... <dest> — sources are everything but the last operand.
+    copied = [src for ln in lines if ln.startswith("COPY ")
+              for src in ln.split()[1:-1]]
+    assert copied, "Dockerfile copies nothing"
+    for src in copied:
+        assert os.path.exists(os.path.join(repo, src.rstrip("/"))), (
+            f"Dockerfile COPY source {src!r} does not exist in the repo"
+        )
+
+    cmd_lines = [ln for ln in lines if ln.startswith("CMD ")]
+    assert cmd_lines, "Dockerfile has no CMD"
+    cmd = json.loads(cmd_lines[-1][4:])
+    assert cmd[:3] == ["python", "-m", "olearning_sim_tpu"]
+    assert os.path.exists(os.path.join(repo, "olearning_sim_tpu",
+                                       "__main__.py"))
+    cfg = cmd[cmd.index("--config") + 1]
+    assert os.path.exists(os.path.join(repo, cfg)), cfg
+
+    # The engine Job's command must reference a script the image copies.
+    for _, obj in OBJS:
+        if obj["kind"] != "Job":
+            continue
+        for c in _pod_spec(obj)["containers"]:
+            script = [a for a in c.get("command", []) if a.endswith(".sh")]
+            for s in script:
+                assert any(s == cp or s.startswith(cp.rstrip("/") + "/")
+                           for cp in copied), (
+                    f"Job command script {s!r} is not copied into the image"
                 )
 
 
