@@ -370,6 +370,7 @@ def main():
             "degraded": degraded,
             "headline": headline,
             "suite_file": None if fast else "BENCH_suite.json",
+            "resilience": headline.get("resilience", _resilience_counters()),
         },
     }
     print(json.dumps(result), flush=True)
@@ -553,10 +554,33 @@ def run_family_subprocess(fam, timeout_s=None):
     return json.loads(body)
 
 
+def _resilience_counters():
+    """Counters from the process-global resilience log (retries, rollbacks,
+    quarantined clients, injected faults). Recorded per family so robustness
+    regressions — a backend that suddenly needs retries to finish a round —
+    show up in the perf trajectory, not just in ad-hoc logs."""
+    try:
+        from olearning_sim_tpu.resilience.events import global_log
+
+        return dict(global_log().counters())
+    except Exception:  # noqa: BLE001 — bench must never die on accounting
+        return {}
+
+
 def run_one_inprocess(plan, fam):
     fam = dict(fam)
     fam["algorithm"] = make_algorithm(fam["algorithm"])
-    return run_family(plan, **fam)
+    # The global log is process-cumulative; in-process suite runs share one
+    # process, so record the delta or family N would inherit families
+    # 1..N-1's retries.
+    before = _resilience_counters()
+    record = run_family(plan, **fam)
+    after = _resilience_counters()
+    record.setdefault("resilience", {
+        k: v - before.get(k, 0) for k, v in after.items()
+        if v - before.get(k, 0)
+    })
+    return record
 
 
 def run_family_once(name):
@@ -609,6 +633,7 @@ def run_one(fam_json, out_path):
     if fam.get("input_shape") is not None:
         fam["input_shape"] = tuple(fam["input_shape"])
     record = run_family(make_mesh_plan(), **fam)
+    record.setdefault("resilience", _resilience_counters())
     with open(out_path, "w") as f:
         json.dump(record, f)
 

@@ -50,8 +50,17 @@ class RoundCheckpointer:
     ``flax.serialization.from_bytes``.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 retry_policy=None, log=None, task_id: str = ""):
+        """``retry_policy`` — optional
+        :class:`~olearning_sim_tpu.resilience.RetryPolicy` applied to save
+        and per-step restore I/O (transient store hiccups); ``log`` — the
+        resilience event sink (defaults to the process-global log)."""
         self.directory = directory
+        self.max_to_keep = max_to_keep
+        self.retry_policy = retry_policy
+        self.log = log
+        self.task_id = task_id
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -59,21 +68,65 @@ class RoundCheckpointer:
             ),
         )
 
+    def _call(self, point: str, fn, *args, **kwargs):
+        from olearning_sim_tpu.resilience import NO_RETRY, faults
+
+        policy = self.retry_policy if self.retry_policy is not None else NO_RETRY
+
+        def op():
+            faults.inject(point, context=self.directory, task_id=self.task_id)
+            return fn(*args, **kwargs)
+
+        return policy.call(op, point=point, task_id=self.task_id, log=self.log)
+
     # -------------------------------------------------------------- save
     def save(self, round_idx: int, states: Dict[str, Any],
-             personal: Dict[str, Any], history: List[Dict[str, Any]]) -> None:
+             personal: Dict[str, Any], history: List[Dict[str, Any]],
+             force: bool = False) -> None:
+        """``force=True`` overwrites an existing step — the rollback-replay
+        path re-saves rounds it re-executes."""
         payload = {
             "states": _strip_keys(states),
             "personal": _strip_keys(personal),
         }
         meta = {"round_idx": int(round_idx), "history": _jsonable(history)}
-        self._mgr.save(
+        self._call(
+            "checkpoint.save",
+            self._mgr.save,
             round_idx,
             args=ocp.args.Composite(
                 tree=ocp.args.StandardSave(payload),
                 meta=ocp.args.JsonSave(meta),
             ),
+            force=force,
         )
+        self._maybe_corrupt(round_idx)
+
+    def _maybe_corrupt(self, round_idx: int) -> None:
+        """Chaos hook: the ``checkpoint.corrupt`` injection point simulates
+        on-disk corruption by truncating the step's largest payload file
+        after a (completed) save — the scenario ``restore``'s fallback
+        exists for. No-op unless a fault plan arms it."""
+        from olearning_sim_tpu.resilience import faults
+
+        spec = faults.fire("checkpoint.corrupt", context=str(round_idx),
+                           round_idx=round_idx, task_id=self.task_id)
+        if spec is None:
+            return
+        import os
+
+        self._mgr.wait_until_finished()
+        step_dir = os.path.join(self.directory, str(round_idx))
+        largest, size = None, -1
+        for dirpath, _dirs, files in os.walk(step_dir):
+            for f in files:
+                p = os.path.join(dirpath, f)
+                s = os.path.getsize(p)
+                if s > size:
+                    largest, size = p, s
+        if largest is not None:
+            with open(largest, "r+b") as f:
+                f.truncate(max(0, size // 2))
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
@@ -89,9 +142,19 @@ class RoundCheckpointer:
         template_personal: Dict[str, Any],
     ) -> Optional[Tuple[int, Dict[str, Any], Dict[str, Any], List[Dict[str, Any]]]]:
         """Returns (last_completed_round, states, personal, history), or None
-        when no checkpoint exists."""
-        step = self._mgr.latest_step()
-        if step is None:
+        when no checkpoint exists.
+
+        Tolerant of a truncated/corrupt newest checkpoint: steps are tried
+        newest-first, and an unreadable step falls back to the previous
+        retained round (logged + counted as ``checkpoint_fallback``) instead
+        of raising — one bad flush must not strand a resumable task. Returns
+        None only when NO retained step is readable (the caller starts
+        fresh, which the event log makes loud)."""
+        from olearning_sim_tpu.resilience import CHECKPOINT_FALLBACK
+        from olearning_sim_tpu.resilience.events import global_log
+
+        steps = sorted((int(s) for s in self._mgr.all_steps()), reverse=True)
+        if not steps:
             return None
         abstract = {
             "states": jax.tree.map(
@@ -101,17 +164,58 @@ class RoundCheckpointer:
                 ocp.utils.to_shape_dtype_struct, _strip_keys(template_personal)
             ),
         }
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                tree=ocp.args.StandardRestore(abstract),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
-        tree, meta = restored["tree"], restored["meta"]
-        states = _rewrap_keys(tree["states"], template_states)
-        personal = _rewrap_keys(tree["personal"], template_personal)
-        return int(meta["round_idx"]), states, personal, list(meta["history"])
+        log = self.log if self.log is not None else global_log()
+        for step in steps:
+            try:
+                restored = self._call(
+                    "checkpoint.restore",
+                    self._mgr.restore,
+                    step,
+                    args=ocp.args.Composite(
+                        tree=ocp.args.StandardRestore(abstract),
+                        meta=ocp.args.JsonRestore(),
+                    ),
+                )
+                tree, meta = restored["tree"], restored["meta"]
+                states = _rewrap_keys(tree["states"], template_states)
+                personal = _rewrap_keys(tree["personal"], template_personal)
+                return (int(meta["round_idx"]), states, personal,
+                        list(meta["history"]))
+            except Exception as e:  # noqa: BLE001 — fall back to older step
+                from olearning_sim_tpu.resilience.retry import NON_RETRYABLE
+
+                if isinstance(e, NON_RETRYABLE):
+                    # A preemption during recovery is process death, not a
+                    # corrupt step — it must bubble, not skip valid steps.
+                    raise
+                log.record(
+                    CHECKPOINT_FALLBACK, point="checkpoint.restore",
+                    task_id=self.task_id, round_idx=int(step),
+                    error=f"{type(e).__name__}: {str(e)[:200]}",
+                    remaining_steps=len([s for s in steps if s < step]),
+                )
+        return None
+
+    def discard_steps_after(self, round_idx: int) -> List[int]:
+        """Delete retained steps newer than ``round_idx`` (rollback-replay:
+        stale/corrupt future checkpoints must not shadow the replayed
+        rounds). Returns the discarded steps."""
+        discarded = []
+        for step in sorted(int(s) for s in self._mgr.all_steps()):
+            if step > round_idx:
+                try:
+                    self._mgr.delete(step)
+                    discarded.append(step)
+                except Exception:  # noqa: BLE001 — a half-deleted corrupt
+                    # step must not abort the rollback; restore() skips
+                    # unreadable steps anyway.
+                    import shutil
+
+                    shutil.rmtree(
+                        f"{self.directory}/{step}", ignore_errors=True
+                    )
+                    discarded.append(step)
+        return discarded
 
     def close(self) -> None:
         self._mgr.close()

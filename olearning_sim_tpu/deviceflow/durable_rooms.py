@@ -133,7 +133,9 @@ class SqliteShelfRoom:
                 " payload BLOB NOT NULL,"
                 " state INTEGER NOT NULL DEFAULT 0)"
             )
-            self._conn.execute("UPDATE shelf SET state=0 WHERE state=1")
+            # state=1 (claimed mid-delivery at crash) and state=2 (parked by
+            # a degraded producer) both return to deliverable on restart.
+            self._conn.execute("UPDATE shelf SET state=0 WHERE state!=0")
 
     def add_shelf(self, flow_id: str) -> None:
         with self._lock, self._conn:
@@ -179,6 +181,23 @@ class SqliteShelfRoom:
         with self._lock, self._conn:
             self._conn.execute(
                 "DELETE FROM shelf WHERE flow_id=? AND state=1", (flow_id,)
+            )
+
+    def park_flow(self, flow_id: str) -> None:
+        """Move the flow's claimed rows to state=2 (parked) — their outbound
+        delivery was degraded (batch dropped by a resilient producer).
+        Parked rows are invisible to ``take_from_shelf``/``shelf_size`` (so a
+        permanently dead sink cannot livelock the dispatcher on the same
+        batch) and to ``ack_flow`` (so the NEXT successful batch's ack cannot
+        sweep them as delivered). Startup recovery returns them to
+        deliverable, so a crash BEFORE the flow releases redelivers them; on
+        a graceful flow release ``close_shelf`` drops them — a bounded,
+        counted loss (the degrading producer already recorded
+        ``outbound_degraded`` with the batch size)."""
+        with self._lock, self._conn:
+            self._conn.execute(
+                "UPDATE shelf SET state=2 WHERE flow_id=? AND state=1",
+                (flow_id,),
             )
 
     def shelf_size(self, flow_id: str) -> int:

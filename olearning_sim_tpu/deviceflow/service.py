@@ -106,6 +106,10 @@ class DeviceFlowService:
 
         return make_outbound_factory(fallback=in_memory)(flow_id, cfg)
 
+    # The inner factory pops "task_id" (per-task degrade accounting); the
+    # bound method inherits this function attribute through getattr.
+    _default_outbound.accepts_task_id = True
+
     # ----------------------------------------------------------------- RPCs
     def register_task(self, task_id: str, total_compute_resources: List[str]) -> bool:
         return self.registry.register_task(task_id, total_compute_resources)
@@ -124,6 +128,11 @@ class DeviceFlowService:
         """Reference ``NotifyStart`` (``deviceflow_server.py:166-260``):
         validate, create/join the flow, start sorting; when every registered
         resource has started, the dispatcher is armed."""
+        from olearning_sim_tpu.resilience import faults
+
+        if faults.fire("deviceflow.notify_start", context=routing_key,
+                       task_id=task_id) is not None:
+            return False, f"injected fault: notify_start {routing_key}"
         if not self.registry.is_registered(task_id):
             return False, f"task {task_id} not registered"
         ok, msg = check_notify_start_params(compute_resource, strategy)
@@ -152,6 +161,11 @@ class DeviceFlowService:
         self, task_id: str, routing_key: str, compute_resource: str,
         flush_timeout: float = 30.0,
     ) -> Tuple[bool, str]:
+        from olearning_sim_tpu.resilience import faults
+
+        if faults.fire("deviceflow.notify_complete", context=routing_key,
+                       task_id=task_id) is not None:
+            return False, f"injected fault: notify_complete {routing_key}"
         # Drain in-flight inbound messages first: updates published before
         # NotifyComplete must not be discarded just because the sort loop
         # hasn't consumed them yet. (The reference has this same race over
@@ -182,7 +196,15 @@ class DeviceFlowService:
         return True, "Pass"
 
     def publish(self, routing_key: str, compute_resource: str, payload: Any) -> None:
-        """Client updates enter here (the Pulsar inbound topic analogue)."""
+        """Client updates enter here (the Pulsar inbound topic analogue).
+        Fault-injection point ``deviceflow.publish`` raises (exception
+        contract: callers own the retry)."""
+        from olearning_sim_tpu.resilience import faults
+
+        faults.inject(
+            "deviceflow.publish", context=routing_key,
+            task_id=(self.flow.get(routing_key) or {}).get("task_id", ""),
+        )
         with self._lock:
             self._enqueued_count += 1
         self.inbound.put(Message(routing_key, compute_resource, payload))
@@ -245,9 +267,14 @@ class DeviceFlowService:
                     if flow_id in self._dispatch_failed:
                         continue
                     try:
-                        producer = self._outbound_factory(
-                            flow_id, params.get("outbound_service", {})
-                        )
+                        cfg = dict(params.get("outbound_service") or {})
+                        if getattr(self._outbound_factory,
+                                   "accepts_task_id", False):
+                            # Only factories that pop the key get it — a
+                            # user factory doing SomeProducer(**cfg) must
+                            # not choke on an unexpected kwarg.
+                            cfg["task_id"] = params.get("task_id", "")
+                        producer = self._outbound_factory(flow_id, cfg)
                     except Exception as e:  # noqa: BLE001
                         # A malformed outbound config fails THIS flow, not
                         # the dispatch loop serving every other task.
@@ -263,9 +290,24 @@ class DeviceFlowService:
                         # Durable shelves: claimed rows are deleted only
                         # after the outbound delivery returns, so a crash
                         # mid-dispatch re-delivers instead of losing them.
+                        park = getattr(self.shelf_room, "park_flow", None)
+
                         def producer(batch, _p=producer, _fid=flow_id,
-                                     _ack=ack_flow):
+                                     _ack=ack_flow, _park=park):
+                            dropped = getattr(_p, "dropped_batches", None)
                             _p(batch)
+                            if dropped is not None and \
+                                    _p.dropped_batches > dropped:
+                                # A resilient producer degraded (dropped)
+                                # this batch: ack would convert the degrade
+                                # into acknowledged data loss; returning the
+                                # rows to deliverable would livelock the
+                                # dispatcher on a dead sink. Park them — a
+                                # crash before flow release redelivers; a
+                                # graceful release drops them (counted).
+                                if _park is not None:
+                                    _park(_fid)
+                                return
                             _ack(_fid)
                     disp = Dispatcher(
                         flow_id=flow_id,

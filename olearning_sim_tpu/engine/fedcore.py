@@ -45,6 +45,11 @@ from olearning_sim_tpu.engine.algorithms import Algorithm
 from olearning_sim_tpu.engine.client_data import ClientDataset
 from olearning_sim_tpu.parallel.mesh import MeshPlan, global_put
 
+from olearning_sim_tpu.utils.compat import ensure_jax_compat
+
+# This module calls jax.shard_map; adapt legacy runtimes before first use.
+ensure_jax_compat()
+
 
 class ServerState(struct.PyTreeNode):
     """Global FL state carried across rounds (the checkpointable unit —
@@ -171,7 +176,13 @@ def _to_varying(tree, axis: str):
     try:
         return jax.lax.pcast(tree, (axis,), to="varying")
     except (AttributeError, TypeError):
+        pass
+    try:
         return jax.lax.pvary(tree, axis)
+    except (AttributeError, TypeError):
+        # Pre-VMA jax: no varying typing exists (and the compat shard_map
+        # shim runs with replication checking off), so identity is correct.
+        return tree
 
 
 def _tree_where(pred, a, b):
@@ -534,26 +545,50 @@ class FedCore:
                         self._local_train,
                         in_axes=(None, 0, 0, 0, 0, 0, None, None),
                     )(params, bx, by, bns, bst, buid, base_key, round_idx)
+                # Resilience gate: a client whose local training diverged
+                # (non-finite loss or any non-finite delta leaf) contributes
+                # NOTHING to the aggregate. Without this, one NaN client
+                # poisons the global params even at weight 0 — the weighted
+                # tensordot reduces 0 * NaN to NaN. For all-finite clients
+                # the gate selects the untouched values, so healthy rounds
+                # are bitwise unchanged.
+                ok = jnp.isfinite(losses)
+                for d in jax.tree.leaves(deltas):
+                    ok = jnp.logical_and(
+                        ok, jnp.isfinite(d.reshape(d.shape[0], -1)).all(axis=1)
+                    )
+
+                def gate(d):
+                    return jnp.where(
+                        ok.reshape((-1,) + (1,) * (d.ndim - 1)), d, 0.0
+                    )
+
+                bw_eff = jnp.where(ok, bw, 0.0)
                 sum_delta = jax.tree.map(
-                    lambda s, d: s + jnp.tensordot(bw, d.astype(jnp.float32), axes=(0, 0)),
+                    lambda s, d: s + jnp.tensordot(
+                        bw_eff, gate(d.astype(jnp.float32)), axes=(0, 0)
+                    ),
                     sum_delta, deltas,
                 )
-                sum_w = sum_w + bw.sum()
-                sum_loss = sum_loss + (bw * losses).sum()
-                count = count + (bw > 0).sum().astype(jnp.float32)
+                sum_w = sum_w + bw_eff.sum()
+                sum_loss = sum_loss + jnp.where(ok, bw * losses, 0.0).sum()
+                count = count + (bw_eff > 0).sum().astype(jnp.float32)
                 if controlled:
-                    # c_i advances only for participating clients; the server
+                    # c_i advances only for participating clients whose
+                    # update survived the finiteness gate; the server
                     # control absorbs the weighted mean correction below.
-                    active = bw > 0
+                    active = bw_eff > 0
 
-                    def gate(d):
+                    def gate_active(d):
                         return jnp.where(
                             active.reshape((-1,) + (1,) * (d.ndim - 1)), d, 0.0
                         )
 
-                    new_bvp = jax.tree.map(lambda v, d: v + gate(d), bvp, dcis)
+                    new_bvp = jax.tree.map(
+                        lambda v, d: v + gate_active(d), bvp, dcis
+                    )
                     sum_dc = jax.tree.map(
-                        lambda s, d: s + jnp.tensordot(bw, d, axes=(0, 0)),
+                        lambda s, d: s + jnp.tensordot(bw_eff, gate(d), axes=(0, 0)),
                         sum_dc, dcis,
                     )
                     ys = (losses, new_bvp)
@@ -563,8 +598,27 @@ class FedCore:
                         in_axes=(0, None, 0, 0, 0, 0, 0, 0, None, None),
                     )(bvp, params, bx, by, bns, bst, buid, bw > 0,
                       base_key, round_idx)
+                    # Keep a client's previous personal params when its
+                    # personal branch diverged — a non-finite v_k would
+                    # otherwise stay poisoned forever. For participating
+                    # finite clients (and frozen non-participants) the new
+                    # value is selected, so healthy rounds are bitwise
+                    # unchanged.
+                    okp = jnp.isfinite(plosses)
+                    for d in jax.tree.leaves(new_vp):
+                        okp = jnp.logical_and(
+                            okp,
+                            jnp.isfinite(d.reshape(d.shape[0], -1)).all(axis=1),
+                        )
+                    keep = jnp.logical_or(okp, jnp.logical_not(bw > 0))
+                    new_vp = jax.tree.map(
+                        lambda nv, ov: jnp.where(
+                            keep.reshape((-1,) + (1,) * (nv.ndim - 1)), nv, ov
+                        ),
+                        new_vp, bvp,
+                    )
                     sum_ploss = sum_ploss + jnp.where(
-                        bw > 0, bw * plosses, 0.0
+                        jnp.logical_and(bw > 0, okp), bw * plosses, 0.0
                     ).sum()
                     ys = (losses, new_vp)
                 else:

@@ -19,7 +19,14 @@ Execution differences (the point of the rebuild):
   external aggregators observe identical Register/NotifyStart/NotifyComplete
   semantics;
 - success/failed counts per device class are derived from per-client finite-
-  loss masks instead of subprocess exit codes (``utils_run_task.py:490-494``).
+  loss masks instead of subprocess exit codes (``utils_run_task.py:490-494``);
+- faults the reference absorbs through process supervision (dead actors,
+  flaky object stores, preempted hosts) are absorbed here by the resilience
+  layer: pass a :class:`~olearning_sim_tpu.resilience.ResilienceConfig` and
+  the round loop gains rollback-and-retry / skip-round failure policies,
+  client quarantine, and deterministic fault-injection points
+  (``runner.round_begin``, ``runner.pre_checkpoint``,
+  ``runner.poison_clients`` — see docs/resilience.md).
 """
 
 from __future__ import annotations
@@ -28,6 +35,7 @@ import contextlib
 import dataclasses
 import json
 import threading
+import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional
 
@@ -39,6 +47,16 @@ from olearning_sim_tpu.deviceflow.trace_compiler import ClientTrace, compile_tra
 from olearning_sim_tpu.engine.client_data import ClientDataset
 from olearning_sim_tpu.engine.fedcore import FedCore
 from olearning_sim_tpu.parallel.mesh import global_put
+from olearning_sim_tpu.resilience import (
+    ROLLBACK,
+    SKIP_ROUND,
+    FailurePolicy,
+    HostPreemption,
+    QuarantineManager,
+    ResilienceConfig,
+    faults,
+)
+from olearning_sim_tpu.resilience.events import global_log
 from olearning_sim_tpu.taskmgr.operator_flow import OperatorFlowController
 from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
 from olearning_sim_tpu.utils.logging import Logger
@@ -109,13 +127,16 @@ class SimulationRunner:
         perf: Optional[Any] = None,
         model_io: Optional[Any] = None,
         warm_start_path: Optional[str] = None,
+        resilience: Optional[ResilienceConfig] = None,
     ):
         """``model_io`` — a :class:`ModelUpdateExporter` realizing the
         reference's model-update-style convention (round r's global model
         exported to storage as ``{task_id}_{r}_result_model.*`` and
         re-ingestable; ``utils_run_task.py:327-397``). ``warm_start_path`` —
         round-0 initial model fetched through ``model_io``'s repo
-        (``Model.modelPath`` with ``useModel``)."""
+        (``Model.modelPath`` with ``useModel``). ``resilience`` — opt-in
+        resilient round execution (None keeps the pre-resilience fail-fast
+        behavior bit-for-bit)."""
         self.task_id = task_id
         self.core = core
         self.populations = populations
@@ -144,6 +165,27 @@ class SimulationRunner:
         # SCAFFOLD control variates per population (control-variate algos).
         self.control_states: Dict[str, Any] = {}
         self.history: List[Dict[str, Any]] = []
+        self.resilience = resilience
+        self._rlog = (resilience.log if resilience is not None and
+                      resilience.log is not None else global_log())
+        self._quarantine: Optional[QuarantineManager] = None
+        if resilience is not None and resilience.quarantine_after is not None:
+            self._quarantine = QuarantineManager(
+                quarantine_after=resilience.quarantine_after,
+                readmit_after=resilience.readmit_after,
+                log=self._rlog, task_id=task_id,
+            )
+        # Last-good-state snapshot for the round currently executing, plus
+        # per-completed-round quarantine snapshots (rollback must restore the
+        # quarantine decisions the replayed rounds originally saw).
+        self._round_snapshot: Optional[Dict[str, Any]] = None
+        self._qsnapshots: Dict[int, Any] = {}
+        # Rounds <= this index are rollback replays: their checkpoint saves
+        # force-overwrite in case a stale step survived the discard.
+        self._force_checkpoint_until = -1
+        # Routing key of the deviceflow flow currently open (None between
+        # operators); closed best-effort when a round fails mid-operator.
+        self._live_routing_key: Optional[str] = None
 
         if not self.task_repo.has_task(task_id):
             self.task_repo.add_task(task_id)
@@ -202,10 +244,28 @@ class SimulationRunner:
         )
 
     # ------------------------------------------------------------- deviceflow
-    def _flow_start(self, operator: OperatorSpec, round_idx: int) -> Optional[str]:
+    def _notify(self, point: str, fn, *args, **kwargs):
+        """Deviceflow RPCs return (ok, msg); under ``resilience.rpc_retry``
+        a not-ok answer (or a raised transient) is retried with backoff
+        before the round-level failure policy ever sees it."""
+        policy = self.resilience.rpc_retry if self.resilience is not None else None
+        if policy is None:
+            return fn(*args, **kwargs)
+        return policy.call(
+            fn, *args, retry_if=lambda r: not r[0], point=point,
+            task_id=self.task_id, log=self._rlog, **kwargs,
+        )
+
+    def _flow_start(self, operator: OperatorSpec, round_idx: int,
+                    attempt: int = 0) -> Optional[str]:
         if self.deviceflow is None or not operator.use_deviceflow:
             return None
         routing_key = f"{self.task_id}_{operator.name}_{round_idx}"
+        if attempt:
+            # A replayed round gets a fresh flow: the failed attempt's flow
+            # (same key) may still be awaiting the release loop, and joining
+            # it would race close_shelf against the replay's updates.
+            routing_key = f"{routing_key}~r{attempt}"
         outbound = None
         if operator.outbound_service:
             try:
@@ -215,7 +275,8 @@ class SimulationRunner:
                     f"operator {operator.name}: outbound_service is not "
                     f"valid JSON: {e}"
                 ) from e
-        ok, msg = self.deviceflow.notify_start(
+        ok, msg = self._notify(
+            "deviceflow.notify_start", self.deviceflow.notify_start,
             self.task_id, routing_key, "logical_simulation",
             operator.deviceflow_strategy or "{}",
             outbound_service=outbound,
@@ -224,10 +285,24 @@ class SimulationRunner:
             raise RuntimeError(f"deviceflow NotifyStart failed for {routing_key}: {msg}")
         return routing_key
 
+    def _abandon_live_flow(self) -> None:
+        """Best-effort NotifyComplete for the flow open at a round failure.
+        Left open, its dispatcher would block on release forever and
+        ``check_dispatch_finished`` would wedge task teardown — even though
+        a retry replays the round under a fresh routing key."""
+        key, self._live_routing_key = self._live_routing_key, None
+        if self.deviceflow is None or key is None:
+            return
+        with contextlib.suppress(Exception):
+            self.deviceflow.notify_complete(
+                self.task_id, key, "logical_simulation"
+            )
+
     def _flow_complete(self, routing_key: Optional[str]) -> None:
         if self.deviceflow is None or routing_key is None:
             return
-        ok, msg = self.deviceflow.notify_complete(
+        ok, msg = self._notify(
+            "deviceflow.notify_complete", self.deviceflow.notify_complete,
             self.task_id, routing_key, "logical_simulation"
         )
         if not ok:
@@ -249,8 +324,16 @@ class SimulationRunner:
             operator=operator.name,
             seed=self.trace_seed,
         )
+        real = p.dataset.num_real_clients
         mask = np.zeros(p.dataset.num_clients, trace.participate.dtype)
-        mask[: p.dataset.num_real_clients] = trace.participate
+        mask[:real] = trace.participate
+        if self._quarantine is not None:
+            # Quarantined clients are masked out exactly like churned-out
+            # devices: zero weight, zero contribution, compiled program
+            # unchanged.
+            mask[:real] = mask[:real] * self._quarantine.active_mask(
+                p.name, real
+            ).astype(mask.dtype)
         participate = global_put(mask, self.core.plan.client_sharding())
         num_steps = None
         if p.num_steps is not None:
@@ -284,6 +367,18 @@ class SimulationRunner:
         self.states[p.name] = state
         client_loss = np.asarray(jax.device_get(metrics.client_loss))
         ok = np.isfinite(client_loss)
+        if self._quarantine is not None:
+            # Strikes accrue only for clients that actually participated and
+            # came back non-finite; quarantine countdowns advance once per
+            # train operator. Quarantined clients are then reported failed in
+            # the per-class accounting — the same way the reference reports
+            # dead phones.
+            self._quarantine.observe(
+                p.name, round_idx, mask[:real] > 0, ok[:real]
+            )
+            for ci in self._quarantine.quarantined(p.name):
+                if ci < len(ok):
+                    ok[ci] = False
         rec = {
             "mean_loss": float(metrics.mean_loss),
             "clients_trained": int(metrics.clients_trained),
@@ -431,6 +526,16 @@ class SimulationRunner:
         if restored is None:
             return 0
         last_round, states, client_states, history = restored
+        # The restore may have fallen back past an unreadable newer step; it
+        # must not stay newest or orbax would refuse the replayed rounds'
+        # saves (StepAlreadyExistsError — this orbax cannot overwrite a step
+        # even with force=True) and every restart would fall back, and
+        # re-lose the replay, again. Deletion does mean a TRANSIENT read
+        # error costs a valid step (recovered by the replay that follows);
+        # wire a retry_policy on remote stores so transients are absorbed
+        # before the fallback treats a step as corrupt.
+        with contextlib.suppress(Exception):
+            self.checkpointer.discard_steps_after(last_round)
         self.states = states
         if self.core.algorithm.personalized:
             self.personal_states = client_states
@@ -451,9 +556,12 @@ class SimulationRunner:
         # Materialize per-client state for every population before saving so
         # the checkpoint's tree structure is deterministic (matches the
         # restore template even when no train operator has run yet).
+        kwargs = {}
+        if round_idx <= self._force_checkpoint_until:
+            kwargs["force"] = True
         self.checkpointer.save(
             round_idx, self.states, self._materialized_client_states(),
-            self.history
+            self.history, **kwargs
         )
 
     def operator_inputs(self, operator: OperatorSpec) -> Dict[str, Any]:
@@ -504,7 +612,338 @@ class SimulationRunner:
             return fn(self, round_idx, operator, p)
         return fn(self, round_idx, operator)
 
+    # ------------------------------------------------------------ resilience
+    @staticmethod
+    def _copy_tree(tree):
+        """Deep-copy a pytree of arrays. Plain references are not enough:
+        ``round_step`` donates the state buffers, so a kept reference would
+        be invalidated the moment the retried round executes."""
+        return jax.tree.map(
+            lambda a: a.copy() if hasattr(a, "copy") else a, tree
+        )
+
+    def _capture_snapshot(self, round_idx: int) -> Dict[str, Any]:
+        return {
+            "round_idx": round_idx,
+            "states": {k: self._copy_tree(v) for k, v in self.states.items()},
+            "personal": {k: self._copy_tree(v)
+                         for k, v in self.personal_states.items()},
+            "control": {k: self._copy_tree(v)
+                        for k, v in self.control_states.items()},
+            "history": list(self.history),
+            "quarantine": (self._quarantine.snapshot()
+                           if self._quarantine is not None else None),
+        }
+
+    def _restore_snapshot(self) -> None:
+        snap = self._round_snapshot
+        if snap is None:
+            return
+        # Copy out of the snapshot (not move): a second failure of the same
+        # round must be able to restore again.
+        self.states = {k: self._copy_tree(v) for k, v in snap["states"].items()}
+        self.personal_states = {
+            k: self._copy_tree(v) for k, v in snap["personal"].items()
+        }
+        self.control_states = {
+            k: self._copy_tree(v) for k, v in snap["control"].items()
+        }
+        self.history = list(snap["history"])
+        if self._quarantine is not None and snap["quarantine"] is not None:
+            self._quarantine.restore(snap["quarantine"])
+
+    def _maybe_poison(self, round_idx: int) -> None:
+        """``runner.poison_clients`` injection point: permanently corrupt the
+        listed clients' features to NaN (a diverged/byzantine device), so
+        their local training produces non-finite updates that exercise the
+        real aggregation gate + quarantine path end-to-end.
+
+        Spec payload: ``{"clients": [...], "population": "name"?}`` —
+        population omitted poisons every population's listed rows."""
+        spec = faults.fire("runner.poison_clients", round_idx=round_idx,
+                           task_id=self.task_id)
+        if spec is None:
+            return
+        payload = spec.payload or {}
+        clients = [int(c) for c in payload.get("clients", [])]
+        pop_name = payload.get("population")
+        for p in self.populations:
+            if pop_name and p.name != pop_name:
+                continue
+            ds = p.dataset
+            x = np.array(jax.device_get(ds.x))
+            # jnp.issubdtype, not np: placed features are usually bfloat16
+            # (an ml_dtypes type numpy's floating hierarchy doesn't know).
+            import jax.numpy as jnp
+
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                self.logger.warning(
+                    task_id=self.task_id, system_name="engine",
+                    module_name="runner",
+                    message=f"poison_clients: population {p.name} has "
+                            f"integer features; NaN poisoning skipped",
+                )
+                continue
+            idx = [c for c in clients if c < ds.num_real_clients]
+            if not idx:
+                continue
+            x[idx] = np.nan
+            host = ClientDataset(
+                x=x,
+                y=np.asarray(jax.device_get(ds.y)),
+                num_samples=np.asarray(jax.device_get(ds.num_samples)),
+                client_uid=np.asarray(jax.device_get(ds.client_uid)),
+                weight=np.asarray(jax.device_get(ds.weight)),
+                num_real_clients=ds.num_real_clients,
+                population_size=ds.population_size,
+            )
+            # Already padded + already in its final feature dtype.
+            p.dataset = host.place(self.core.plan, feature_dtype=None)
+
+    def _rollback(self, round_idx: int,
+                  error: BaseException) -> Optional[int]:
+        """Restore the last good state; returns the round to (re-)execute,
+        or None when nothing restorable exists.
+
+        A generic failure rolls back to the in-memory snapshot of this
+        round's entry state (falling back to the checkpointer when
+        ``snapshot_rounds`` is off). A :class:`HostPreemption` models process
+        death: recovery prefers the checkpointer (falling back across corrupt
+        steps), replaying any rounds after the last readable checkpoint — or
+        resuming *past* the failed round when its checkpoint already
+        committed before death. When NO checkpoint has committed yet the
+        in-memory snapshot is used as a lenient approximation (a really
+        preempted host would replay from round 0); chaos plans probing strict
+        durability should preempt only after the first checkpoint."""
+        preempt = isinstance(error, HostPreemption)
+        # Quarantine state as of the failure: the right state to keep when
+        # the checkpoint shows the failed round durably completed (its
+        # observe() already ran before the save).
+        qcur = (self._quarantine.snapshot()
+                if self._quarantine is not None else None)
+        had_snapshot = self._round_snapshot is not None
+        self._restore_snapshot()
+        resume_round = round_idx
+        if self.checkpointer is not None:
+            with contextlib.suppress(Exception):
+                # A save may be in flight (or have failed) at "death".
+                self.checkpointer.wait()
+            if preempt or not had_snapshot:
+                resumed = self._try_resume()
+                if resumed == 0 and not had_snapshot:
+                    # No checkpoint yet and no snapshot: nothing was
+                    # restored, so a retry would replay on partially
+                    # mutated state.
+                    return None
+                if resumed > 0:
+                    resume_round = resumed
+                    if self._quarantine is not None:
+                        qsnap = (qcur if resume_round > round_idx
+                                 else self._qsnapshots.get(resume_round - 1))
+                        if qsnap is not None:
+                            self._quarantine.restore(qsnap)
+                    if resume_round != round_idx:
+                        self._round_snapshot = None  # belongs to another round
+            # Replayed rounds re-save their steps; a partially-saved step
+            # from the failed attempt (or stale/corrupt future steps after a
+            # checkpoint fallback) must not shadow them or trip save
+            # collisions.
+            with contextlib.suppress(Exception):
+                self.checkpointer.discard_steps_after(resume_round - 1)
+            self._force_checkpoint_until = max(
+                self._force_checkpoint_until, round_idx
+            )
+        self._rlog.record(
+            ROLLBACK, point="runner.rollback", task_id=self.task_id,
+            round_idx=round_idx, to_round=resume_round, preempt=preempt,
+            error=f"{type(error).__name__}: {str(error)[:200]}",
+        )
+        return resume_round
+
+    def _handle_round_failure(self, round_idx: int, attempts: int,
+                              error: BaseException):
+        """Dispatch a failed round per the operator-level failure policy.
+        Returns (action, next_round, next_attempts); action "raise" tells the
+        caller to re-raise ``error``."""
+        cfg = self.resilience
+        policy = cfg.failure_policy if cfg is not None else FailurePolicy.FAIL_TASK
+        self.logger.error(
+            task_id=self.task_id, system_name="engine", module_name="runner",
+            message=f"round {round_idx} failed "
+                    f"({type(error).__name__}: {error}); policy={policy}",
+        )
+        if cfg is None or policy == FailurePolicy.FAIL_TASK:
+            return "raise", round_idx, attempts
+        if policy == FailurePolicy.SKIP_ROUND:
+            if self._round_snapshot is None:
+                # No rollback source: skipping would keep the round's
+                # partial mutations. Degrade to fail_task.
+                self.logger.error(
+                    task_id=self.task_id, system_name="engine",
+                    module_name="runner",
+                    message="skip_round needs snapshot_rounds; failing task",
+                )
+                return "raise", round_idx, attempts
+            self._restore_snapshot()
+            if self.checkpointer is not None:
+                # The round may have checkpointed before failing (e.g. the
+                # stop barrier or model export failed after the save); that
+                # step holds the state this skip just discarded and must not
+                # resurrect it on a restart.
+                with contextlib.suppress(Exception):
+                    self.checkpointer.wait()
+                with contextlib.suppress(Exception):
+                    self.checkpointer.discard_steps_after(round_idx - 1)
+            self._rlog.record(
+                SKIP_ROUND, point="runner.round", task_id=self.task_id,
+                round_idx=round_idx,
+                error=f"{type(error).__name__}: {str(error)[:200]}",
+            )
+            self.history.append({
+                "round": round_idx, "skipped": True,
+                "error": f"{type(error).__name__}: {str(error)[:200]}",
+            })
+            return "continue", round_idx + 1, 0
+        # FailurePolicy.RETRY
+        if attempts >= cfg.max_round_retries:
+            # Retries exhausted: degrade to fail_task.
+            return "raise", round_idx, attempts
+        if self._round_snapshot is None and self.checkpointer is None:
+            # Nothing to roll back to: re-running on partially mutated
+            # state would double-apply trained populations.
+            self.logger.error(
+                task_id=self.task_id, system_name="engine",
+                module_name="runner",
+                message="retry needs snapshot_rounds or a checkpointer; "
+                        "failing task",
+            )
+            return "raise", round_idx, attempts
+        next_round = self._rollback(round_idx, error)
+        if next_round is None:
+            # No snapshot and no readable checkpoint: state is partially
+            # mutated with nothing to restore from. Degrade to fail_task.
+            self.logger.error(
+                task_id=self.task_id, system_name="engine",
+                module_name="runner",
+                message="retry found no recoverable state; failing task",
+            )
+            return "raise", round_idx, attempts
+        if cfg.round_backoff_s > 0:
+            time.sleep(cfg.round_backoff_s * (attempts + 1))
+        return "continue", next_round, attempts + 1
+
+    def _persist_resilience(self) -> None:
+        """Per-task resilience digest into the task table (the task status
+        API's ``resilience`` column; TaskManager.get_resilience)."""
+        summary = self._rlog.summary(self.task_id)
+        if not summary["counters"]:
+            return
+        with contextlib.suppress(Exception):
+            self.task_repo.set_item_value(
+                self.task_id, "resilience", json.dumps(summary)
+            )
+
     # -------------------------------------------------------------------- run
+    def _execute_round(self, round_idx: int, attempt: int = 0) -> str:
+        """One full round: barriers, operators, accounting, checkpoint,
+        model export. Returns "ok", "stop" (cooperative stop observed), or
+        "final" (final-round stop barrier tolerated)."""
+        if not self.operator_flow.start():
+            if self.stop_event is not None and self.stop_event.is_set():
+                return "stop"  # barrier abandoned due to stop request
+            raise RuntimeError(f"round {round_idx}: operator-flow start failed")
+
+        round_record: Dict[str, Any] = {"round": round_idx}
+        self._round_outputs = {}
+        for operator in self.operators:
+            routing_key = self._flow_start(operator, round_idx, attempt)
+            # Tracked so a failure mid-operator can close the flow: an open
+            # flow's dispatcher blocks on NotifyComplete forever, which
+            # wedges check_dispatch_finished and with it task teardown —
+            # even when a retry replays the round under a fresh key.
+            self._live_routing_key = routing_key
+            ok_by_population: Dict[str, np.ndarray] = {}
+            op_record: Dict[str, Any] = {}
+            # Only train operators advance clients: eval/custom must not
+            # inflate the device-rounds/sec metric of record. Total client
+            # steps honors heterogeneous per-class profiles so per-step
+            # latency is not biased by config.max_local_steps.
+            nc = total_steps = 0
+            if operator.kind == "train":
+                for p in self.populations:
+                    real = p.dataset.num_real_clients
+                    nc += real
+                    total_steps += (
+                        int(np.sum(p.num_steps[:real]))
+                        if p.num_steps is not None
+                        else real * self.core.config.max_local_steps
+                    )
+            timer = self.perf.time_round(
+                self.task_id, round_idx, operator.name, num_clients=nc,
+                local_steps=self.core.config.max_local_steps,
+                total_client_steps=total_steps,
+            ) if self.perf is not None else contextlib.nullcontext()
+            with timer:
+                for p in self.populations:
+                    if operator.kind == "train":
+                        r = self._run_train(p, round_idx, operator)
+                        ok_by_population[p.name] = r.pop("ok_mask")
+                    elif operator.kind == "eval":
+                        r = self._run_eval(p)
+                        ok_by_population[p.name] = np.ones(
+                            p.dataset.num_clients, bool
+                        )
+                    elif operator.kind == "custom":
+                        r = self._call_custom(operator, round_idx, p) or {}
+                        ok_by_population[p.name] = r.pop(
+                            "ok_mask", np.ones(p.dataset.num_clients, bool)
+                        )
+                    else:
+                        raise ValueError(f"unknown operator kind {operator.kind!r}")
+                    op_record[p.name] = r
+            self._flow_complete(routing_key)
+            self._live_routing_key = None
+            self._analyze_results(operator, round_idx, ok_by_population)
+            round_record[operator.name] = op_record
+            self._round_outputs[operator.name] = op_record
+
+        self.history.append(round_record)
+        # A preemption here ("runner.pre_checkpoint") dies with the round's
+        # work done but not yet durable — the classic lost-round scenario the
+        # checkpoint-rollback path must absorb.
+        faults.inject("runner.pre_checkpoint", context=str(round_idx),
+                      round_idx=round_idx, task_id=self.task_id)
+        self._checkpoint(round_idx)
+        if self.model_io is not None and not self._model_io_export_dead:
+            # One global model per task (reference convention); multi-
+            # population tasks export the first population's.
+            try:
+                self.model_io.export(
+                    round_idx,
+                    self._host_params(
+                        self.states[self.populations[0].name].params
+                    ),
+                )
+            except NotImplementedError as e:
+                # Download-only repo (HTTP warm start): ingestion works,
+                # export cannot — disable it once, loudly.
+                self._model_io_export_dead = True
+                self.logger.warning(
+                    task_id=self.task_id, system_name="engine",
+                    module_name="runner",
+                    message=f"model export disabled: {e}",
+                )
+
+        if not self.operator_flow.stop():
+            if self.stop_event is not None and self.stop_event.is_set():
+                return "stop"
+            if round_idx < self.rounds - 1:
+                raise RuntimeError(f"round {round_idx}: operator-flow stop failed")
+            # Final round: the work is done; don't block on the barrier
+            # (reference ``run_task.py:319-322``).
+            return "final"
+        return "ok"
+
     def run(self) -> List[Dict[str, Any]]:
         for p in self.populations:
             if p.name not in self.states:
@@ -523,99 +962,81 @@ class SimulationRunner:
             # resume supersedes it (no wasted fetch on restarts).
             self._warm_start()
 
-        for round_idx in range(start_round, self.rounds):
+        cfg = self.resilience
+        snapshotting = cfg is not None and cfg.snapshot_rounds and (
+            cfg.failure_policy != FailurePolicy.FAIL_TASK
+        )
+        if self._quarantine is not None:
+            self._qsnapshots[start_round - 1] = self._quarantine.snapshot()
+        round_idx = start_round
+        # Retry budget is PER ROUND (not a running counter): a rollback that
+        # resumes earlier than the failed round replays intervening rounds
+        # successfully, and those successes must not refill the budget of a
+        # deterministically failing round (infinite replay loop otherwise).
+        retries: Dict[int, int] = {}
+        # Monotonic per-rollback epoch for deviceflow routing-key suffixes:
+        # any round executed as a replay needs a key its earlier execution
+        # never used, or it joins a flow still awaiting the release loop.
+        flow_epoch = 0
+        while round_idx < self.rounds:
             if self.stop_event is not None and self.stop_event.is_set():
                 # Cooperative stop between rounds (reference analogue:
                 # stopTask -> Ray job stop, ``task_manager.py:358-455``).
                 self.stopped = True
                 break
-            if not self.operator_flow.start():
-                if self.stop_event is not None and self.stop_event.is_set():
-                    self.stopped = True  # barrier abandoned due to stop request
-                    break
-                raise RuntimeError(f"round {round_idx}: operator-flow start failed")
-
-            round_record: Dict[str, Any] = {"round": round_idx}
-            self._round_outputs = {}
-            for operator in self.operators:
-                routing_key = self._flow_start(operator, round_idx)
-                ok_by_population: Dict[str, np.ndarray] = {}
-                op_record: Dict[str, Any] = {}
-                # Only train operators advance clients: eval/custom must not
-                # inflate the device-rounds/sec metric of record. Total client
-                # steps honors heterogeneous per-class profiles so per-step
-                # latency is not biased by config.max_local_steps.
-                nc = total_steps = 0
-                if operator.kind == "train":
-                    for p in self.populations:
-                        real = p.dataset.num_real_clients
-                        nc += real
-                        total_steps += (
-                            int(np.sum(p.num_steps[:real]))
-                            if p.num_steps is not None
-                            else real * self.core.config.max_local_steps
-                        )
-                timer = self.perf.time_round(
-                    self.task_id, round_idx, operator.name, num_clients=nc,
-                    local_steps=self.core.config.max_local_steps,
-                    total_client_steps=total_steps,
-                ) if self.perf is not None else contextlib.nullcontext()
-                with timer:
-                    for p in self.populations:
-                        if operator.kind == "train":
-                            r = self._run_train(p, round_idx, operator)
-                            ok_by_population[p.name] = r.pop("ok_mask")
-                        elif operator.kind == "eval":
-                            r = self._run_eval(p)
-                            ok_by_population[p.name] = np.ones(
-                                p.dataset.num_clients, bool
-                            )
-                        elif operator.kind == "custom":
-                            r = self._call_custom(operator, round_idx, p) or {}
-                            ok_by_population[p.name] = r.pop(
-                                "ok_mask", np.ones(p.dataset.num_clients, bool)
-                            )
-                        else:
-                            raise ValueError(f"unknown operator kind {operator.kind!r}")
-                        op_record[p.name] = r
-                self._flow_complete(routing_key)
-                self._analyze_results(operator, round_idx, ok_by_population)
-                round_record[operator.name] = op_record
-                self._round_outputs[operator.name] = op_record
-
-            self.history.append(round_record)
-            self._checkpoint(round_idx)
-            if self.model_io is not None and not self._model_io_export_dead:
-                # One global model per task (reference convention); multi-
-                # population tasks export the first population's.
-                try:
-                    self.model_io.export(
-                        round_idx,
-                        self._host_params(
-                            self.states[self.populations[0].name].params
-                        ),
-                    )
-                except NotImplementedError as e:
-                    # Download-only repo (HTTP warm start): ingestion works,
-                    # export cannot — disable it once, loudly.
-                    self._model_io_export_dead = True
-                    self.logger.warning(
-                        task_id=self.task_id, system_name="engine",
-                        module_name="runner",
-                        message=f"model export disabled: {e}",
-                    )
-
-            if not self.operator_flow.stop():
-                if self.stop_event is not None and self.stop_event.is_set():
-                    self.stopped = True
-                    break
-                if round_idx < self.rounds - 1:
-                    raise RuntimeError(f"round {round_idx}: operator-flow stop failed")
-                # Final round: the work is done; don't block on the barrier
-                # (reference ``run_task.py:319-322``).
+            if snapshotting and (
+                self._round_snapshot is None
+                or self._round_snapshot["round_idx"] != round_idx
+            ):
+                self._round_snapshot = self._capture_snapshot(round_idx)
+            replaying = (round_idx <= self._force_checkpoint_until
+                         or retries.get(round_idx, 0) > 0)
+            try:
+                faults.inject("runner.round_begin", context=str(round_idx),
+                              round_idx=round_idx, task_id=self.task_id)
+                self._maybe_poison(round_idx)
+                status = self._execute_round(
+                    round_idx, flow_epoch if replaying else 0
+                )
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — policy dispatch
+                self._abandon_live_flow()
+                action, next_round, new_attempts = self._handle_round_failure(
+                    round_idx, retries.get(round_idx, 0), e
+                )
+                if action == "raise":
+                    self._persist_resilience()
+                    raise
+                retries[round_idx] = new_attempts
+                round_idx = next_round
+                flow_epoch += 1
+                continue
+            retries.pop(round_idx, None)
+            if self._quarantine is not None:
+                self._qsnapshots[round_idx] = self._quarantine.snapshot()
+                # Retention must cover the deepest possible rollback: a
+                # preemption can fall back across every retained checkpoint
+                # step — max_to_keep steps spaced checkpoint_every rounds
+                # apart — and _rollback then needs the quarantine state as
+                # of the resume round's entry.
+                keep = max(
+                    8,
+                    getattr(self.checkpointer, "max_to_keep", 0)
+                    * max(1, self.checkpoint_every) + 2,
+                ) if self.checkpointer is not None else 8
+                for k in [k for k in self._qsnapshots
+                          if k < round_idx - keep]:
+                    del self._qsnapshots[k]
+            if status == "stop":
+                self.stopped = True
                 break
+            if status == "final":
+                break
+            round_idx += 1
         if self.checkpointer is not None:
             # Orbax saves are async; block until the last step is durably
             # committed so a process exit right after run() can't lose it.
             self.checkpointer.wait()
+        self._persist_resilience()
         return self.history

@@ -352,6 +352,16 @@ def build_runner_from_taskconfig(
             warm_start_path = m.modelPath
         break
 
+    # Resilience knobs ride the engine params blob (docs/resilience.md):
+    #   {"resilience": {"failure_policy": "retry", "max_round_retries": 2,
+    #                   "quarantine_after": 1, "readmit_after": 3,
+    #                   "rpc_retry": {"max_attempts": 3, "base_delay": 0.05}}}
+    resilience = None
+    if params.get("resilience"):
+        from olearning_sim_tpu.resilience import ResilienceConfig
+
+        resilience = ResilienceConfig.from_dict(params["resilience"])
+
     return SimulationRunner(
         task_id=tc.taskID.taskID,
         core=core,
@@ -366,4 +376,5 @@ def build_runner_from_taskconfig(
         checkpointer=checkpointer,
         model_io=model_io,
         warm_start_path=warm_start_path,
+        resilience=resilience,
     )

@@ -22,6 +22,11 @@ import numpy as np
 
 from olearning_sim_tpu.models.registry import ModelSpec, register_model
 
+from olearning_sim_tpu.utils.compat import ensure_jax_compat
+
+# This module calls jax.shard_map; adapt legacy runtimes before first use.
+ensure_jax_compat()
+
 
 class TransformerBlock(nn.Module):
     width: int

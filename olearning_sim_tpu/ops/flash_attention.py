@@ -33,6 +33,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from olearning_sim_tpu.utils.compat import ensure_jax_compat
+
+# This module calls jax.shard_map; adapt legacy runtimes before first use.
+ensure_jax_compat()
+
+
 try:  # pltpu is importable on CPU builds too; guard for safety
     from jax.experimental.pallas import tpu as pltpu
 

@@ -28,6 +28,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from olearning_sim_tpu.parallel.mesh import MeshPlan, global_put
 from olearning_sim_tpu.parallel.tp import _path_str, sharded_fraction
 
+from olearning_sim_tpu.utils.compat import ensure_jax_compat
+
+# This module calls jax.shard_map; adapt legacy runtimes before first use.
+ensure_jax_compat()
+
+
 _EXPERT_PREFIX = "expert_"
 
 # Same "fraction of elements on sharded leaves" metric as tensor

@@ -28,6 +28,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from olearning_sim_tpu.parallel.mesh import MeshPlan, global_put
 
+from olearning_sim_tpu.utils.compat import ensure_jax_compat
+
+# This module calls jax.shard_map; adapt legacy runtimes before first use.
+ensure_jax_compat()
+
 
 def _validate_sp_inputs(model, tokens, plan: MeshPlan, caller: str) -> None:
     if plan.sp <= 1:

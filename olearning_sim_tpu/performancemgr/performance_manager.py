@@ -67,12 +67,17 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
 class PerformanceManager:
     """Records timings, answers performance queries, controls the profiler."""
 
-    def __init__(self, repo: Optional[TableRepo] = None, keep_last: int = 4096):
+    def __init__(self, repo: Optional[TableRepo] = None, keep_last: int = 4096,
+                 resilience_log=None):
         # No repo by default: queries are answered from the bounded in-memory
         # window. Pass a repo to persist every row for external analysis —
         # retention is then the caller's policy (rows are append-only).
+        # ``resilience_log`` — the ResilienceLog whose counters get_resilience
+        # reports; pass the runner's instance when it is not the process-
+        # global default (ResilienceConfig(log=...)).
         self.repo = repo
         self.keep_last = keep_last
+        self.resilience_log = resilience_log
         self._lock = threading.RLock()
         self._timings: Dict[str, List[RoundTiming]] = {}
         self._trace_dir: Optional[str] = None
@@ -134,13 +139,27 @@ class PerformanceManager:
         )
 
     # --------------------------------------------------------------- queries
+    def get_resilience(self, task_id: str) -> Dict[str, int]:
+        """Resilience counters for one task (retries, rollbacks, quarantines,
+        injected faults — olearning_sim_tpu.resilience.events). Part of the
+        performance answer so robustness regressions ride the same query as
+        throughput regressions."""
+        log = self.resilience_log
+        if log is None:
+            from olearning_sim_tpu.resilience.events import global_log
+
+            log = global_log()
+        return log.counters(task_id)
+
     def get_performance(self, task_id: str) -> Dict[str, Any]:
         """Summary for one task: throughput + latency distribution
         (the ``PerformanceMgr.getPerformance`` answer)."""
+        resilience = self.get_resilience(task_id)
         with self._lock:
             rows = list(self._timings.get(task_id, []))
         if not rows:
-            return {"task_id": task_id, "rounds_recorded": 0}
+            return {"task_id": task_id, "rounds_recorded": 0,
+                    "resilience": resilience}
         durations = sorted(t.duration_s for t in rows)
         total_time = sum(durations)
         total_clients = sum(t.num_clients for t in rows)
@@ -159,6 +178,7 @@ class PerformanceManager:
                 "max": durations[-1],
             },
             "per_client_step_latency_s": _mean_step_latency(rows),
+            "resilience": resilience,
         }
 
     def list_tasks(self) -> List[str]:

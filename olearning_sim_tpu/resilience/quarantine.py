@@ -1,0 +1,172 @@
+"""Client quarantine: bench clients that produce non-finite updates.
+
+The engine already refuses to aggregate a non-finite client contribution
+(``fedcore`` gates each client's delta on finiteness), so a diverged client
+cannot poison the global model — but it still *burns compute* every round it
+participates and it pollutes the success/failed accounting with repeat
+offenders. The quarantine manager tracks per-client health across rounds:
+
+- a client observed non-finite while participating accrues a strike; at
+  ``quarantine_after`` consecutive bad rounds it is quarantined (excluded
+  from the participation mask entirely — zero weight, zero local steps);
+- after ``readmit_after`` quarantined rounds it is re-admitted on probation
+  (half-open, circuit-breaker style); a clean round clears its strikes, a
+  bad one re-quarantines it immediately.
+
+Exclusion happens through the same masked-participation mechanism the
+deviceflow trace compiler uses, so a quarantined client is indistinguishable
+(to the compiled program) from a churned-out device — and it shows up as
+``failed`` in the per-class success/failed accounting, which is exactly how
+the reference reports dead phones.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from olearning_sim_tpu.resilience.events import (
+    QUARANTINE,
+    READMIT,
+    ResilienceLog,
+    global_log,
+)
+
+
+class _PopulationState:
+    def __init__(self, num_clients: int):
+        self.strikes = np.zeros(num_clients, np.int32)
+        # Remaining quarantined rounds; 0 = active.
+        self.remaining = np.zeros(num_clients, np.int32)
+        self.total_quarantines = np.zeros(num_clients, np.int32)
+
+
+class QuarantineManager:
+    def __init__(
+        self,
+        quarantine_after: int = 1,
+        readmit_after: int = 3,
+        log: Optional[ResilienceLog] = None,
+        task_id: str = "",
+    ):
+        self.quarantine_after = max(1, int(quarantine_after))
+        self.readmit_after = max(1, int(readmit_after))
+        self.log = log if log is not None else global_log()
+        self.task_id = task_id
+        self._lock = threading.Lock()
+        self._pops: Dict[str, _PopulationState] = {}
+
+    def _pop(self, name: str, num_clients: int) -> _PopulationState:
+        st = self._pops.get(name)
+        if st is None or len(st.strikes) < num_clients:
+            st = _PopulationState(num_clients)
+            self._pops[name] = st
+        return st
+
+    # ------------------------------------------------------------- queries
+    def active_mask(self, name: str, num_clients: int) -> np.ndarray:
+        """[num_clients] float mask: 1 for admitted clients, 0 quarantined.
+        Multiplies the trace participation mask in the runner."""
+        with self._lock:
+            st = self._pop(name, num_clients)
+            return (st.remaining[:num_clients] == 0).astype(np.float32)
+
+    def quarantined(self, name: str) -> List[int]:
+        with self._lock:
+            st = self._pops.get(name)
+            if st is None:
+                return []
+            return [int(i) for i in np.nonzero(st.remaining > 0)[0]]
+
+    def num_quarantined(self) -> int:
+        with self._lock:
+            return sum(int((st.remaining > 0).sum())
+                       for st in self._pops.values())
+
+    # ------------------------------------------------------------ seeding
+    def preseed(self, name: str, clients: Iterable[int],
+                num_clients: int, rounds: Optional[int] = None) -> None:
+        """Quarantine ``clients`` up-front (baseline construction for chaos
+        parity tests; also useful to fence known-bad devices). ``rounds``
+        None = effectively forever."""
+        with self._lock:
+            st = self._pop(name, num_clients)
+            dur = np.iinfo(np.int32).max if rounds is None else int(rounds)
+            for c in clients:
+                st.remaining[int(c)] = dur
+
+    # ---------------------------------------------------------- snapshotting
+    def snapshot(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """Copy of the full per-population state — taken by the runner after
+        each good round so a rollback restores quarantine decisions bitwise
+        (a replayed round must see exactly the masks the original saw)."""
+        with self._lock:
+            return {
+                name: {
+                    "strikes": st.strikes.copy(),
+                    "remaining": st.remaining.copy(),
+                    "total_quarantines": st.total_quarantines.copy(),
+                }
+                for name, st in self._pops.items()
+            }
+
+    def restore(self, snap: Dict[str, Dict[str, np.ndarray]]) -> None:
+        with self._lock:
+            self._pops.clear()
+            for name, arrays in snap.items():
+                st = _PopulationState(len(arrays["strikes"]))
+                st.strikes = arrays["strikes"].copy()
+                st.remaining = arrays["remaining"].copy()
+                st.total_quarantines = arrays["total_quarantines"].copy()
+                self._pops[name] = st
+
+    # ----------------------------------------------------------- observing
+    def observe(self, name: str, round_idx: int, participated: np.ndarray,
+                ok: np.ndarray) -> List[int]:
+        """Digest one round's per-client outcome for population ``name``.
+
+        ``participated`` — bool [C]: clients the round actually released
+        (trace participation x quarantine mask). ``ok`` — bool [C]: finite
+        update. Returns the newly quarantined client indices. Also advances
+        quarantine countdowns and re-admits clients whose term expired.
+        """
+        participated = np.asarray(participated, bool)
+        ok = np.asarray(ok, bool)
+        n = len(participated)
+        newly: List[int] = []
+        readmitted: List[int] = []
+        with self._lock:
+            st = self._pop(name, n)
+            strikes, remaining = st.strikes, st.remaining
+            # Countdown for quarantined clients; term expiry = probation.
+            serving = remaining[:n] > 0
+            remaining[:n][serving] -= 1
+            done = serving & (remaining[:n] == 0)
+            if done.any():
+                strikes[:n][done] = self.quarantine_after - 1  # one strike left
+                readmitted = [int(i) for i in np.nonzero(done)[0]]
+            bad = participated & ~ok
+            good = participated & ok
+            strikes[:n][good] = 0
+            strikes[:n][bad] += 1
+            trip = bad & (strikes[:n] >= self.quarantine_after)
+            if trip.any():
+                remaining[:n][trip] = self.readmit_after
+                st.total_quarantines[:n][trip] += 1
+                strikes[:n][trip] = 0
+                newly = [int(i) for i in np.nonzero(trip)[0]]
+        if newly:
+            self.log.record(
+                QUARANTINE, point="runner.quarantine", task_id=self.task_id,
+                round_idx=round_idx, population=name,
+                clients=newly[:64], num_clients=len(newly),
+            )
+        if readmitted:
+            self.log.record(
+                READMIT, point="runner.quarantine", task_id=self.task_id,
+                round_idx=round_idx, population=name,
+                clients=readmitted[:64], num_clients=len(readmitted),
+            )
+        return newly

@@ -12,6 +12,7 @@ from olearning_sim_tpu.storage.file_repo import (
     HttpFileRepo,
     LocalFileRepo,
     MinioFileRepo,
+    ResilientFileRepo,
     S3FileRepo,
     fetch_operator_code,
     make_file_repo,
@@ -21,6 +22,7 @@ from olearning_sim_tpu.storage.fragment_repo import (
     FragmentRepo,
     JsonFragmentRepo,
     QueueFragmentRepo,
+    ResilientFragmentRepo,
 )
 
 __all__ = [
@@ -36,4 +38,6 @@ __all__ = [
     "FragmentRepo",
     "JsonFragmentRepo",
     "QueueFragmentRepo",
+    "ResilientFileRepo",
+    "ResilientFragmentRepo",
 ]

@@ -263,6 +263,68 @@ class MinioFileRepo(FileRepo):
             return []
 
 
+class ResilientFileRepo(FileRepo):
+    """Wrap any :class:`FileRepo` with retry/backoff + fault injection.
+
+    The bool-contract methods (upload/download/delete) are retried both on
+    raised exceptions and on returned ``False`` (the backends' native failure
+    signal); after the policy is exhausted the last result/exception is
+    surfaced unchanged, so callers keep their existing contracts.
+    ``NotImplementedError`` (capability statements, e.g. HTTP upload) passes
+    straight through. Fault-injection points: ``storage.upload``,
+    ``storage.download``, ``storage.delete``, ``storage.list``.
+    """
+
+    def __init__(self, inner: FileRepo, retry_policy=None, log=None,
+                 task_id: str = ""):
+        from olearning_sim_tpu.resilience import NO_RETRY
+
+        self.inner = inner
+        self.retry_policy = retry_policy if retry_policy is not None else NO_RETRY
+        self.log = log
+        self.task_id = task_id
+
+    def _call(self, point: str, context: str, fn, *args,
+              bool_contract: bool = True):
+        from olearning_sim_tpu.resilience import faults
+
+        def op():
+            spec = faults.fire(point, context=context, task_id=self.task_id)
+            if spec is not None:
+                if bool_contract and spec.error in ("false", "corrupt"):
+                    return False
+                # Non-bool APIs (list_files) get the exception flavor even
+                # for "false" specs — returning False would violate their
+                # List[str] contract.
+                raise faults.exception_for(spec, point, context)
+            return fn(*args)
+
+        return self.retry_policy.call(
+            op, retry_if=(lambda r: r is False) if bool_contract else None,
+            point=point, task_id=self.task_id, log=self.log,
+        )
+
+    def upload_file(self, local_path: str, remote_path: str) -> bool:
+        return self._call("storage.upload", remote_path,
+                          self.inner.upload_file, local_path, remote_path)
+
+    def download_file(self, remote_path: str, local_path: str) -> bool:
+        return self._call("storage.download", remote_path,
+                          self.inner.download_file, remote_path, local_path)
+
+    def delete_file(self, remote_path: str) -> bool:
+        return self._call("storage.delete", remote_path,
+                          self.inner.delete_file, remote_path)
+
+    def list_files(self, prefix: str = "") -> List[str]:
+        return self._call("storage.list", prefix, self.inner.list_files,
+                          prefix, bool_contract=False)
+
+    def exists(self, remote_path: str) -> bool:
+        # Delegate so LocalFileRepo's direct-stat fast path survives wrapping.
+        return self.inner.exists(remote_path)
+
+
 def storage_settings_from_env() -> dict:
     """Object-store connection settings from the environment (the reference
     reads them from ``config/manager_config.yaml``; the deployment config
@@ -278,14 +340,25 @@ def storage_settings_from_env() -> dict:
 
 def make_file_repo(transfer_type: FileTransferType, *, root: str = "/",
                    endpoint: str = "", access_key: str = "", secret_key: str = "",
-                   bucket: str = "", secure: bool = False) -> FileRepo:
+                   bucket: str = "", secure: bool = False,
+                   retry_policy=None) -> FileRepo:
     """Factory keyed by the proto transfer-type enum (the dispatch the
-    reference does ad hoc at every download site, ``utils_run_task.py:174-325``)."""
+    reference does ad hoc at every download site, ``utils_run_task.py:174-325``).
+
+    ``retry_policy`` — optional :class:`~olearning_sim_tpu.resilience.RetryPolicy`;
+    when given the repo is wrapped in :class:`ResilientFileRepo` (transient
+    I/O failures retried with backoff, fault-injection points armed)."""
+
+    def _wrap(repo: FileRepo) -> FileRepo:
+        if retry_policy is None:
+            return repo
+        return ResilientFileRepo(repo, retry_policy=retry_policy)
+
     t = FileTransferType(transfer_type)
     if t == FileTransferType.FILE:
-        return LocalFileRepo(root=root)
+        return _wrap(LocalFileRepo(root=root))
     if t == FileTransferType.HTTP:
-        return HttpFileRepo()
+        return _wrap(HttpFileRepo())
     if t in (FileTransferType.S3, FileTransferType.MINIO) and not endpoint:
         env = storage_settings_from_env()
         if not env["endpoint"]:
@@ -301,10 +374,11 @@ def make_file_repo(transfer_type: FileTransferType, *, root: str = "/",
         bucket = bucket or env["bucket"]
         secure = secure or env["secure"]
     if t == FileTransferType.S3:
-        return S3FileRepo(endpoint_url=endpoint, access_key=access_key,
-                          secret_key=secret_key, bucket=bucket)
-    return MinioFileRepo(endpoint=endpoint, access_key=access_key,
-                         secret_key=secret_key, bucket=bucket, secure=secure)
+        return _wrap(S3FileRepo(endpoint_url=endpoint, access_key=access_key,
+                                secret_key=secret_key, bucket=bucket))
+    return _wrap(MinioFileRepo(endpoint=endpoint, access_key=access_key,
+                               secret_key=secret_key, bucket=bucket,
+                               secure=secure))
 
 
 def fetch_operator_code(repo: FileRepo, remote_path: str, dest_dir: str,

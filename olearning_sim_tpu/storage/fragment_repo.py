@@ -91,6 +91,45 @@ class QueueFragmentRepo(FragmentRepo):
             return None
 
 
+class ResilientFragmentRepo(FragmentRepo):
+    """Retry/backoff + fault injection around any fragment transport.
+
+    A cross-process transport (Pulsar-alike, gRPC stream) drops and times out;
+    this wrapper gives the aggregator-side consumer the same retry discipline
+    as file I/O. Fault-injection points: ``fragment.put``, ``fragment.get``.
+    """
+
+    def __init__(self, inner: FragmentRepo, retry_policy=None, log=None,
+                 task_id: str = ""):
+        from olearning_sim_tpu.resilience import NO_RETRY
+
+        self.inner = inner
+        self.retry_policy = retry_policy if retry_policy is not None else NO_RETRY
+        self.log = log
+        self.task_id = task_id
+
+    def put_fragment(self, fragment: Fragment) -> None:
+        from olearning_sim_tpu.resilience import faults
+
+        def op():
+            faults.inject("fragment.put", context=fragment.client_id,
+                          task_id=self.task_id)
+            self.inner.put_fragment(fragment)
+
+        self.retry_policy.call(op, point="fragment.put",
+                               task_id=self.task_id, log=self.log)
+
+    def get_fragment(self, timeout: Optional[float] = None) -> Optional[Fragment]:
+        from olearning_sim_tpu.resilience import faults
+
+        def op():
+            faults.inject("fragment.get", task_id=self.task_id)
+            return self.inner.get_fragment(timeout=timeout)
+
+        return self.retry_policy.call(op, point="fragment.get",
+                                      task_id=self.task_id, log=self.log)
+
+
 class JsonFragmentRepo(QueueFragmentRepo):
     """JSON-wire variant (reference ``json_fragment_repo.py:8-43``): producers
     enqueue serialized strings, the consumer parses on receipt."""

@@ -96,6 +96,8 @@ class TaskManager:
         perf=None,
         logger: Optional[Logger] = None,
         intake_queue=None,
+        retry_policy=None,
+        resilience_log=None,
     ):
         """``runner_factory(task_config, task_repo, deviceflow, stop_event)``
         builds the engine runner for a scheduled task; defaults to the
@@ -116,6 +118,17 @@ class TaskManager:
         self._interrupt_queue_time = interrupt_queue_time
         self._interrupt_running_time = interrupt_running_time
         self._auto_create_rows = auto_create_rows
+        # Transient-failure discipline for job submission and device-half
+        # polling (ISSUE: resilience layer). Default: one retry with a short
+        # backoff — enough to ride out a scheduler hiccup without changing
+        # the failure semantics tests rely on.
+        from olearning_sim_tpu.resilience import RetryPolicy
+        from olearning_sim_tpu.resilience.events import global_log
+
+        self._retry_policy = retry_policy if retry_policy is not None else \
+            RetryPolicy(max_attempts=2, base_delay=0.1, max_delay=1.0)
+        self._resilience_log = resilience_log if resilience_log is not None \
+            else global_log()
         from olearning_sim_tpu.taskmgr.hybrid import CostModel
 
         self._cost_model = cost_model if cost_model is not None else CostModel()
@@ -165,6 +178,25 @@ class TaskManager:
                 self._task_repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
                 self._task_repo.set_item_value(
                     task_id, "task_finished_time", time.strftime("%Y-%m-%d %H:%M:%S")
+                )
+            elif status == TaskStatus.RUNNING.name:
+                # RUNNING row with no frozen resources: the process died
+                # inside the launch window (after the status write, before
+                # the resource_occupied flip) or the row was hand-edited.
+                # Either way the in-process job is gone — mark it
+                # interrupted-and-failed so it is never silently stuck
+                # RUNNING forever with no job behind it.
+                self.logger.error(
+                    task_id=task_id, system_name="TaskMgr", module_name="recover",
+                    message="RUNNING task has no engine job across restart; "
+                            "marking interrupted (failed)",
+                )
+                self._task_repo.set_item_value(
+                    task_id, "task_status", TaskStatus.FAILED.name
+                )
+                self._task_repo.set_item_value(
+                    task_id, "task_finished_time",
+                    time.strftime("%Y-%m-%d %H:%M:%S"),
                 )
 
     def _default_runner_factory(self, tc, stop_event):
@@ -262,6 +294,18 @@ class TaskManager:
 
     def get_task_queue(self) -> list:
         return self._task_queue.get_task_ids()
+
+    def get_resilience(self, task_id: str) -> Dict[str, Any]:
+        """Resilience digest for one task (task status API surface): the
+        runner-persisted per-task blob when present, else the live event
+        log's per-task summary."""
+        blob = self._task_repo.get_item_value(task_id, "resilience")
+        if blob:
+            try:
+                return json.loads(blob)
+            except (TypeError, ValueError):
+                pass
+        return self._resilience_log.summary(task_id)
 
     def change_scheduler(self, name: str) -> bool:
         try:
@@ -371,7 +415,17 @@ class TaskManager:
         if not self._task_repo.get_item_value(task_id, "device_target"):
             # No device sub-job was launched for this task.
             return {"is_finished": True, "device_result": []}
-        result = self._phone_client.get_device_task_status(task_id)
+        from olearning_sim_tpu.resilience import faults
+
+        def _poll():
+            faults.inject("taskmgr.device_poll", context=task_id,
+                          task_id=task_id)
+            return self._phone_client.get_device_task_status(task_id)
+
+        result = self._retry_policy.call(
+            _poll, point="taskmgr.device_poll", task_id=task_id,
+            log=self._resilience_log,
+        )
         repo = self._task_repo
         repo.set_item_value(task_id, "device_round", result.get("round", 0))
         repo.set_item_value(task_id, "device_operator", result.get("operator", ""))
@@ -551,9 +605,34 @@ class TaskManager:
             repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
             return
         try:
-            job_id = self._launcher.submit(
-                lambda stop_event: self._runner_factory(tc, stop_event),
-                job_id=f"job-{task_id}",
+            from olearning_sim_tpu.resilience import faults
+
+            attempt = [0]
+
+            def _submit():
+                # Idempotence under retry: submit is not transactional — a
+                # failure after the launcher registered the job must not
+                # launch a second runner against the same task row and
+                # checkpoint directory on the retry attempt. Retry attempts
+                # only (the first attempt must always launch — a stale LIVE
+                # record from a prior submission of this task_id must not
+                # satisfy a fresh submission), and only a LIVE record
+                # short-circuits.
+                attempt[0] += 1
+                if attempt[0] > 1:
+                    existing = self._launcher.get_job_status(f"job-{task_id}")
+                    if existing in (TaskStatus.PENDING, TaskStatus.RUNNING):
+                        return f"job-{task_id}"
+                faults.inject("taskmgr.submit_job", context=task_id,
+                              task_id=task_id)
+                return self._launcher.submit(
+                    lambda stop_event: self._runner_factory(tc, stop_event),
+                    job_id=f"job-{task_id}",
+                )
+
+            job_id = self._retry_policy.call(
+                _submit, point="taskmgr.submit_job", task_id=task_id,
+                log=self._resilience_log,
             )
         except Exception as e:  # noqa: BLE001
             self.logger.error(task_id=task_id, system_name="TaskMgr",

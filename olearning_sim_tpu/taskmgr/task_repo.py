@@ -27,6 +27,7 @@ TASK_COLUMNS = [
     "device_operator",
     "device_result",
     "job_id",
+    "resilience",         # JSON digest of resilience counters/events (runner)
     "resource_occupied",
     "in_queue_time",
     "submit_task_time",

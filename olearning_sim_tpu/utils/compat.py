@@ -1,0 +1,54 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current ``jax.shard_map`` API (top-level export,
+``axis_names=`` manual-axes selection, varying-manual-axes typing via
+``jax.lax.pvary``). Older runtimes (<= 0.4.x) ship the same machinery as
+``jax.experimental.shard_map.shard_map`` with the complementary ``auto=``
+parameter and no VMA typing. Rather than gate every call site, the package
+installs a thin adapter at import time when (and only when) the running jax
+lacks the modern surface — one robustness layer instead of N sprinkled
+version checks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def ensure_jax_compat() -> None:
+    """Install ``jax.shard_map`` on runtimes that predate the top-level API.
+
+    Semantics mapping: ``axis_names={a, ...}`` (axes manual in the body)
+    becomes ``auto = mesh.axis_names - axis_names``; replication checking is
+    disabled because pre-VMA runtimes cannot type device-varying carries
+    (``jax.lax.pvary`` does not exist there — see ``fedcore._to_varying``,
+    which degrades to identity for the same reason).
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    @functools.wraps(_legacy_shard_map)
+    def shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, **kwargs):
+        auto = kwargs.pop("auto", None)
+        if auto is None:
+            auto = frozenset()
+            if axis_names is not None:
+                auto = frozenset(getattr(mesh, "axis_names", ())) - frozenset(
+                    axis_names
+                )
+        if f is None:
+            # Decorator form: jax.shard_map(mesh=..., ...)(f)
+            return lambda fn: shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                axis_names=axis_names, auto=auto,
+            )
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False, auto=frozenset(auto),
+        )
+
+    jax.shard_map = shard_map

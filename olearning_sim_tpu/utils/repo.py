@@ -142,6 +142,18 @@ class SqliteTableRepo(TableRepo):
         cols = ", ".join(f"{c} TEXT" for c in self.columns)
         with self._lock:
             self._conn.execute(f"CREATE TABLE IF NOT EXISTS {table} ({cols})")
+            # Schema evolution: a DB file created by an older build may lack
+            # columns added since (e.g. "resilience"); CREATE IF NOT EXISTS
+            # keeps the old table, so add any missing ones in place.
+            existing = {
+                row[1] for row in
+                self._conn.execute(f"PRAGMA table_info({table})")
+            }
+            for c in self.columns:
+                if c not in existing:
+                    self._conn.execute(
+                        f"ALTER TABLE {table} ADD COLUMN {c} TEXT"
+                    )
             self._conn.commit()
 
     def _col(self, name: str) -> str:

@@ -24,3 +24,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: long randomized fault-injection sweeps (run with -m chaos); "
+        "the seeded deterministic chaos smoke test stays in tier-1",
+    )

@@ -1,0 +1,214 @@
+"""Seeded chaos tests for resilient round execution.
+
+Tier-1 keeps the deterministic, CPU-only scenarios (fast smoke + the
+acceptance-grade end-to-end run); the long randomized sweep is behind
+``-m chaos`` (and ``slow``, so tier-1's ``-m 'not slow'`` excludes it).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.checkpoint import ModelUpdateExporter, RoundCheckpointer
+from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.engine.runner import (
+    DataPopulation,
+    OperatorSpec,
+    SimulationRunner,
+)
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+from olearning_sim_tpu.resilience import (
+    CHECKPOINT_FALLBACK,
+    QUARANTINE,
+    RETRY,
+    ROLLBACK,
+    SKIP_ROUND,
+    FailurePolicy,
+    FaultPlan,
+    FaultSpec,
+    ResilienceConfig,
+    ResilienceLog,
+    fast_test_policy,
+    faults,
+)
+from olearning_sim_tpu.storage import LocalFileRepo, ResilientFileRepo
+
+NUM_CLIENTS = 16
+ROUNDS = 5
+POISONED = [3, 7]
+
+
+@pytest.fixture(scope="module")
+def plan():
+    return make_mesh_plan()
+
+
+@pytest.fixture(scope="module")
+def core(plan):
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    return build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (8,), "num_classes": 3},
+        input_shape=(8,),
+    )
+
+
+def make_runner(core, plan, log, ckpt=None, model_io=None, rounds=ROUNDS,
+                failure_policy=FailurePolicy.RETRY, task_id="chaos-task"):
+    ds = make_synthetic_dataset(
+        7, NUM_CLIENTS, 6, (8,), 3, class_sep=3.0
+    ).pad_for(plan, 2).place(plan)
+    pop = DataPopulation(
+        name="data_0", dataset=ds, device_classes=["c"],
+        class_of_client=np.zeros(ds.num_clients, int),
+        nums=[NUM_CLIENTS], dynamic_nums=[0],
+    )
+    res = ResilienceConfig(
+        failure_policy=failure_policy, max_round_retries=2,
+        quarantine_after=1, readmit_after=32, snapshot_rounds=True, log=log,
+    )
+    return SimulationRunner(
+        task_id=task_id, core=core, populations=[pop],
+        operators=[OperatorSpec(name="train")], rounds=rounds,
+        checkpointer=ckpt, model_io=model_io, resilience=res,
+    )
+
+
+def _params(runner):
+    return jax.tree.leaves(jax.device_get(runner.states["data_0"].params))
+
+
+def test_chaos_smoke_transient_save_fault(core, plan, tmp_path):
+    """Fast seeded smoke (tier-1): one injected checkpoint-save I/O fault is
+    absorbed by the retry policy; the run completes untouched."""
+    log = ResilienceLog()
+    ckpt = RoundCheckpointer(str(tmp_path / "ck"), max_to_keep=2,
+                             retry_policy=fast_test_policy(3), log=log)
+    runner = make_runner(core, plan, log, ckpt=ckpt, rounds=2)
+    fault_plan = FaultPlan(seed=11, specs=[
+        FaultSpec(point="checkpoint.save", times=1, error="io"),
+    ])
+    with faults.chaos(fault_plan, log=log):
+        history = runner.run()
+    assert [h["round"] for h in history] == [0, 1]
+    assert log.count("fault_injected") == 1
+    assert log.count(RETRY) >= 1
+    ckpt.wait()
+    assert ckpt.latest_round() == 1
+
+
+def test_skip_round_policy_degrades_gracefully(core, plan):
+    log = ResilienceLog()
+    runner = make_runner(core, plan, log, rounds=3,
+                         failure_policy=FailurePolicy.SKIP_ROUND)
+    fault_plan = FaultPlan(seed=2, specs=[
+        FaultSpec(point="runner.round_begin", rounds=[1], error="io"),
+    ])
+    with faults.chaos(fault_plan, log=log):
+        history = runner.run()
+    assert log.count(SKIP_ROUND) == 1
+    skipped = [h for h in history if h.get("skipped")]
+    assert len(skipped) == 1 and skipped[0]["round"] == 1
+    # The other rounds executed normally.
+    assert [h["round"] for h in history] == [0, 1, 2]
+
+
+def test_chaos_run_matches_fault_free_survivors(core, plan, tmp_path):
+    """Acceptance: a multi-round run with injected storage faults, one
+    checkpoint corruption, one simulated preemption, and NaN clients
+    completes with the same final global params as a fault-free run of the
+    surviving population (bitwise on CPU), with quarantine/rollback events
+    in the resilience log."""
+    log = ResilienceLog()
+    ckpt = RoundCheckpointer(
+        str(tmp_path / "ck"), max_to_keep=4,
+        retry_policy=fast_test_policy(3), log=log, task_id="chaos-task",
+    )
+    model_repo = ResilientFileRepo(
+        LocalFileRepo(root=str(tmp_path / "models")),
+        retry_policy=fast_test_policy(3), log=log, task_id="chaos-task",
+    )
+    model_io = ModelUpdateExporter(model_repo, "chaos-task",
+                                   scratch_dir=str(tmp_path / "scratch"))
+    runner = make_runner(core, plan, log, ckpt=ckpt, model_io=model_io)
+    fault_plan = FaultPlan(seed=42, specs=[
+        # NaN clients from round 0 (a diverged device): gated out of the
+        # aggregate, then quarantined for the rest of the run.
+        FaultSpec(point="runner.poison_clients", rounds=[0],
+                  payload={"clients": POISONED}),
+        # Transient object-store hiccups: model export + checkpoint save.
+        FaultSpec(point="storage.upload", times=1, error="io"),
+        FaultSpec(point="checkpoint.save", times=1, error="io"),
+        # Round 2's checkpoint is silently truncated on disk...
+        FaultSpec(point="checkpoint.corrupt", rounds=[2]),
+        # ...and the host is preempted entering round 3: recovery must fall
+        # back past the corrupt step to round 1 and replay rounds 2-4.
+        FaultSpec(point="runner.round_begin", rounds=[3], error="preempt"),
+    ])
+    with faults.chaos(fault_plan, log=log):
+        history = runner.run()
+
+    assert [h["round"] for h in history] == list(range(ROUNDS))
+    assert log.count("fault_injected") >= 5
+    assert log.count(RETRY) >= 2
+    assert log.count(ROLLBACK) == 1
+    assert log.count(QUARANTINE) >= 1
+    assert log.count(CHECKPOINT_FALLBACK) >= 1
+    # The digest is persisted for the task status API.
+    import json as _json
+
+    blob = runner.task_repo.get_item_value("chaos-task", "resilience")
+    assert blob and _json.loads(blob)["counters"][ROLLBACK] == 1
+
+    # Fault-free baseline over the surviving population: the poisoned
+    # clients are fenced out up-front, everything else is identical.
+    base = make_runner(core, plan, ResilienceLog())
+    base._quarantine.preseed("data_0", POISONED, NUM_CLIENTS)
+    base.run()
+
+    faulted, clean = _params(runner), _params(base)
+    assert len(faulted) == len(clean)
+    for x, y in zip(faulted, clean):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chaos_randomized_sweep_is_replayable(core, plan, tmp_path, seed):
+    """Long randomized sweep (behind -m chaos): probabilistic transient
+    faults across storage + checkpoint + RPC points. The whole chaos run must
+    replay bit-identically from (plan, seed), and the platform must either
+    finish every round or fail loudly — never finish with silent gaps."""
+    def one_run(tag):
+        log = ResilienceLog()
+        ckpt = RoundCheckpointer(
+            str(tmp_path / f"ck-{tag}-{seed}"), max_to_keep=3,
+            retry_policy=fast_test_policy(4), log=log,
+        )
+        runner = make_runner(core, plan, log, ckpt=ckpt, rounds=4)
+        fault_plan = FaultPlan(seed=seed, specs=[
+            FaultSpec(point="checkpoint.save", times=-1, probability=0.3,
+                      error="io"),
+            FaultSpec(point="storage.upload", times=-1, probability=0.3,
+                      error="io"),
+            FaultSpec(point="runner.poison_clients", rounds=[0],
+                      payload={"clients": [seed % NUM_CLIENTS]}),
+        ])
+        completed = None
+        with faults.chaos(fault_plan, log=log):
+            try:
+                completed = [h["round"] for h in runner.run()]
+            except IOError:
+                pass  # retries exhausted: loud failure is acceptable
+        return completed, log.counters(), _params(runner)
+
+    rounds_a, counters_a, params_a = one_run("a")
+    rounds_b, counters_b, params_b = one_run("b")
+    assert rounds_a == rounds_b
+    assert counters_a == counters_b
+    for x, y in zip(params_a, params_b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    if rounds_a is not None:
+        assert rounds_a == [0, 1, 2, 3]

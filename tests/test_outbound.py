@@ -162,11 +162,16 @@ def test_grpc_producer_round_trip():
 
 
 def test_factory_dispatch():
+    from olearning_sim_tpu.deviceflow.outbound import ResilientProducer
+
     fallback_calls = []
     factory = make_outbound_factory(
         fallback=lambda fid, cfg: fallback_calls.append((fid, cfg)) or (lambda b: None)
     )
-    assert isinstance(factory("f", {"type": "websocket", "url": "ws://x"}), WebsocketProducer)
+    # Network producers come back wrapped in the retry/degrade layer.
+    ws = factory("f", {"type": "websocket", "url": "ws://x"})
+    assert isinstance(ws, ResilientProducer)
+    assert isinstance(ws.inner, WebsocketProducer)
     factory("f", {"type": "memory"})
     assert fallback_calls and fallback_calls[0][0] == "f"
     with pytest.raises(ValueError):

@@ -1,0 +1,442 @@
+"""Resilience layer units + satellite regressions: retry policy, seeded
+fault injection, quarantine lifecycle, resilient storage/outbound wrappers,
+checkpoint-corruption fallback, and TaskManager crash recovery."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.resilience import (
+    CHECKPOINT_FALLBACK,
+    OUTBOUND_DEGRADED,
+    QUARANTINE,
+    READMIT,
+    RETRY,
+    RETRY_EXHAUSTED,
+    FaultError,
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    HostPreemption,
+    QuarantineManager,
+    ResilienceLog,
+    RetryPolicy,
+    fast_test_policy,
+    faults,
+)
+from olearning_sim_tpu.storage import LocalFileRepo, ResilientFileRepo
+from olearning_sim_tpu.storage.fragment_repo import (
+    Fragment,
+    JsonFragmentRepo,
+    ResilientFragmentRepo,
+)
+
+
+# ---------------------------------------------------------------- RetryPolicy
+def test_retry_policy_absorbs_transients():
+    log = ResilienceLog()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise IOError("transient")
+        return "ok"
+
+    policy = fast_test_policy(max_attempts=3)
+    assert policy.call(flaky, point="t", log=log) == "ok"
+    assert len(calls) == 3
+    assert log.count(RETRY) == 2
+
+
+def test_retry_policy_exhaustion_reraises():
+    log = ResilienceLog()
+
+    def always_fails():
+        raise IOError("down")
+
+    with pytest.raises(IOError):
+        fast_test_policy(max_attempts=2).call(always_fails, point="t", log=log)
+    assert log.count(RETRY) == 1
+    assert log.count(RETRY_EXHAUSTED) == 1
+
+
+def test_retry_policy_bool_contract_returns_final_result():
+    log = ResilienceLog()
+    results = iter([False, False, False])
+    policy = fast_test_policy(max_attempts=3)
+    out = policy.call(lambda: next(results),
+                      retry_if=lambda r: r is False, point="t", log=log)
+    assert out is False  # contract preserved: no invented exception
+    assert log.count(RETRY_EXHAUSTED) == 1
+
+    results = iter([False, True])
+    assert policy.call(lambda: next(results),
+                       retry_if=lambda r: r is False, point="t", log=log)
+
+
+def test_retry_policy_never_absorbs_preemption():
+    calls = []
+
+    def preempted():
+        calls.append(1)
+        raise HostPreemption("host gone")
+
+    with pytest.raises(HostPreemption):
+        fast_test_policy(max_attempts=5).call(preempted)
+    assert len(calls) == 1
+
+
+def test_retry_policy_backoff_is_deterministic():
+    a = list(RetryPolicy(max_attempts=4, seed=7).delays())
+    b = list(RetryPolicy(max_attempts=4, seed=7).delays())
+    assert a == b
+    assert all(d0 <= d1 or d1 == 2.0 for d0, d1 in zip(a, a[1:]))
+
+
+# ------------------------------------------------------------ fault injection
+def test_fault_plan_filters_and_counts():
+    log = ResilienceLog()
+    plan = FaultPlan(specs=[
+        FaultSpec(point="storage.upload", times=2, after=1, match="model"),
+    ], seed=0)
+    inj = FaultInjector(plan, log=log)
+    # hit 0: skipped by after=1; hits 1-2 fire; hit 3 exhausted.
+    assert inj.fire("storage.upload", context="model_a") is None
+    assert inj.fire("storage.upload", context="model_a") is not None
+    assert inj.fire("storage.upload", context="other") is None  # match filter
+    assert inj.fire("storage.upload", context="model_b") is not None
+    assert inj.fire("storage.upload", context="model_c") is None
+    assert log.count("fault_injected") == 2
+
+
+def test_fault_injection_is_seed_deterministic():
+    def firing_pattern(seed):
+        inj = FaultInjector(FaultPlan(
+            specs=[FaultSpec(point="p", times=-1, probability=0.3)],
+            seed=seed,
+        ), log=ResilienceLog())
+        return [inj.fire("p") is not None for _ in range(64)]
+
+    assert firing_pattern(5) == firing_pattern(5)
+    assert firing_pattern(5) != firing_pattern(6)
+    assert any(firing_pattern(5))
+
+
+def test_fault_round_filter_and_json_roundtrip():
+    plan = FaultPlan(specs=[
+        FaultSpec(point="runner.round_begin", rounds=[2], error="preempt"),
+    ], seed=3)
+    plan2 = FaultPlan.from_json(plan.to_json())
+    inj = FaultInjector(plan2, log=ResilienceLog())
+    assert inj.fire("runner.round_begin", round_idx=1) is None
+    with pytest.raises(HostPreemption):
+        inj.check("runner.round_begin", round_idx=2)
+
+
+def test_module_level_inject_noop_without_plan():
+    faults.install(None)
+    faults.inject("storage.upload")  # must be free and silent
+    assert faults.fire("storage.upload") is None
+
+
+# ----------------------------------------------------------------- quarantine
+def test_quarantine_lifecycle():
+    log = ResilienceLog()
+    qm = QuarantineManager(quarantine_after=2, readmit_after=2, log=log)
+    part = np.ones(4, bool)
+    bad_client = np.array([False, True, False, False])
+
+    # Strike 1: not yet quarantined.
+    qm.observe("pop", 0, part, ~bad_client)
+    assert qm.quarantined("pop") == []
+    # Strike 2: quarantined.
+    qm.observe("pop", 1, part, ~bad_client)
+    assert qm.quarantined("pop") == [1]
+    assert qm.active_mask("pop", 4).tolist() == [1, 0, 1, 1]
+    assert log.count(QUARANTINE) == 1
+
+    # Serves its term (2 rounds) without participating...
+    mask = qm.active_mask("pop", 4).astype(bool)
+    qm.observe("pop", 2, part & mask, np.ones(4, bool))
+    assert qm.quarantined("pop") == [1]
+    qm.observe("pop", 3, part & qm.active_mask("pop", 4).astype(bool),
+               np.ones(4, bool))
+    # ...then is re-admitted on probation.
+    assert qm.quarantined("pop") == []
+    assert log.count(READMIT) == 1
+
+    # One bad probation round re-quarantines immediately.
+    qm.observe("pop", 4, part, ~bad_client)
+    assert qm.quarantined("pop") == [1]
+
+
+def test_quarantine_clean_round_clears_strikes():
+    qm = QuarantineManager(quarantine_after=2, readmit_after=2,
+                           log=ResilienceLog())
+    part = np.ones(3, bool)
+    qm.observe("pop", 0, part, np.array([False, True, True]))  # strike 1
+    qm.observe("pop", 1, part, np.ones(3, bool))               # clean: reset
+    qm.observe("pop", 2, part, np.array([False, True, True]))  # strike 1 again
+    assert qm.quarantined("pop") == []
+
+
+def test_quarantine_snapshot_restore_roundtrip():
+    qm = QuarantineManager(quarantine_after=1, readmit_after=5,
+                           log=ResilienceLog())
+    part = np.ones(4, bool)
+    qm.observe("pop", 0, part, np.array([True, False, True, True]))
+    snap = qm.snapshot()
+    qm.observe("pop", 1, part, np.array([True, True, False, False]))
+    assert sorted(qm.quarantined("pop")) == [1, 2, 3]
+    qm.restore(snap)
+    assert qm.quarantined("pop") == [1]
+
+
+def test_quarantine_preseed_is_effectively_permanent():
+    qm = QuarantineManager(log=ResilienceLog())
+    qm.preseed("pop", [0, 2], num_clients=4)
+    for r in range(50):
+        mask = qm.active_mask("pop", 4).astype(bool)
+        qm.observe("pop", r, mask, np.ones(4, bool))
+    assert sorted(qm.quarantined("pop")) == [0, 2]
+
+
+# ----------------------------------------------------------- resilient repos
+def test_resilient_file_repo_retries_injected_faults(tmp_path):
+    log = ResilienceLog()
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload")
+    repo = ResilientFileRepo(
+        LocalFileRepo(root=str(tmp_path)),
+        retry_policy=fast_test_policy(max_attempts=3),
+        log=log,
+    )
+    plan = FaultPlan(specs=[
+        FaultSpec(point="storage.upload", times=1, error="io"),
+        FaultSpec(point="storage.download", times=1, error="false"),
+    ])
+    with faults.chaos(plan, log=log):
+        assert repo.upload_file(str(src), "a/b.bin")
+        dst = tmp_path / "out.bin"
+        assert repo.download_file("a/b.bin", str(dst))
+    assert dst.read_bytes() == b"payload"
+    assert log.count(RETRY) == 2
+    assert log.count("fault_injected") == 2
+
+
+def test_resilient_file_repo_exhaustion_keeps_bool_contract(tmp_path):
+    log = ResilienceLog()
+    repo = ResilientFileRepo(
+        LocalFileRepo(root=str(tmp_path)),
+        retry_policy=fast_test_policy(max_attempts=2),
+        log=log,
+    )
+    plan = FaultPlan(specs=[FaultSpec(point="storage.upload", times=-1,
+                                      error="false")])
+    src = tmp_path / "s.bin"
+    src.write_bytes(b"x")
+    with faults.chaos(plan, log=log):
+        assert repo.upload_file(str(src), "dst.bin") is False
+    assert log.count(RETRY_EXHAUSTED) == 1
+
+
+def test_resilient_fragment_repo_retries(tmp_path):
+    log = ResilienceLog()
+    repo = ResilientFragmentRepo(
+        JsonFragmentRepo(),
+        retry_policy=fast_test_policy(max_attempts=3),
+        log=log,
+    )
+    plan = FaultPlan(specs=[FaultSpec(point="fragment.put", times=1)])
+    frag = Fragment(task_id="t", client_id="c1", round_idx=0,
+                    payload={"w": [1.0]})
+    with faults.chaos(plan, log=log):
+        repo.put_fragment(frag)
+    got = repo.get_fragment(timeout=1.0)
+    assert got is not None and got.client_id == "c1"
+    assert log.count(RETRY) == 1
+
+
+# ------------------------------------------------- outbound degrade satellite
+def test_outbound_degrades_instead_of_crashing():
+    from olearning_sim_tpu.deviceflow.outbound import ResilientProducer
+
+    log = ResilienceLog()
+    sent, dead = [], [True]
+
+    def sink(batch):
+        if dead[0]:
+            raise ConnectionError("websocket closed")
+        sent.extend(batch)
+
+    producer = ResilientProducer(
+        sink, "flow-1", retry_policy=fast_test_policy(max_attempts=2),
+        on_failure="degrade", log=log,
+    )
+    producer(["m1", "m2"])  # sink dead: dropped, not raised
+    assert producer.dropped_batches == 1
+    assert producer.dropped_messages == 2
+    assert log.count(OUTBOUND_DEGRADED) == 1
+    dead[0] = False
+    producer(["m3"])  # sink came back: next batch flows
+    assert sent == ["m3"]
+
+
+def test_outbound_raise_policy_keeps_old_behavior():
+    from olearning_sim_tpu.deviceflow.outbound import ResilientProducer
+
+    def sink(batch):
+        raise ConnectionError("down")
+
+    producer = ResilientProducer(
+        sink, "flow-1", retry_policy=fast_test_policy(max_attempts=2),
+        on_failure="raise", log=ResilienceLog(),
+    )
+    with pytest.raises(ConnectionError):
+        producer(["m"])
+
+
+def test_outbound_factory_wraps_network_producers_only():
+    from olearning_sim_tpu.deviceflow.outbound import (
+        ResilientProducer,
+        make_outbound_factory,
+    )
+
+    fallback_sink = lambda b: None
+    factory = make_outbound_factory(fallback=lambda fid, cfg: fallback_sink)
+    # In-memory fallback is not wrapped (cannot fail transiently).
+    assert factory("f", {"type": "memory"}) is fallback_sink
+    ws = factory("f", {"type": "websocket", "url": "ws://x"})
+    assert isinstance(ws, ResilientProducer)
+
+
+# --------------------------------------- checkpoint corruption fallback + mgr
+def _corrupt_step_dir(directory, step):
+    step_dir = os.path.join(directory, str(step))
+    assert os.path.isdir(step_dir)
+    for dirpath, _dirs, files in os.walk(step_dir):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            size = os.path.getsize(p)
+            with open(p, "r+b") as fh:
+                fh.truncate(max(0, size // 2))
+
+
+def test_restore_falls_back_past_corrupt_checkpoint(tmp_path):
+    """Satellite regression: a truncated newest checkpoint must fall back to
+    the previous retained round instead of raising."""
+    import jax
+
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+    from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.engine.runner import DataPopulation, OperatorSpec, SimulationRunner
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+    plan = make_mesh_plan()
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore("mlp2", fedavg(0.1), plan, cfg,
+                         model_overrides={"hidden": (8,), "num_classes": 3},
+                         input_shape=(8,))
+    ds = make_synthetic_dataset(1, 8, 4, (8,), 3).pad_for(plan, 2).place(plan)
+
+    def make_runner(ckpt):
+        pop = DataPopulation(
+            name="pop", dataset=ds, device_classes=["c"],
+            class_of_client=np.zeros(ds.num_clients, int),
+            nums=[ds.num_real_clients], dynamic_nums=[0],
+        )
+        return SimulationRunner(
+            task_id="corrupt-task", core=core, populations=[pop],
+            operators=[OperatorSpec(name="train")], rounds=3,
+            checkpointer=ckpt,
+        )
+
+    log = ResilienceLog()
+    ckpt = RoundCheckpointer(str(tmp_path / "ck"), max_to_keep=3, log=log)
+    make_runner(ckpt).run()
+    ckpt.wait()
+    assert ckpt.latest_round() == 2
+    _corrupt_step_dir(str(tmp_path / "ck"), 2)
+
+    ckpt2 = RoundCheckpointer(str(tmp_path / "ck"), max_to_keep=3, log=log)
+    runner2 = make_runner(ckpt2)
+    history = runner2.run()
+    # Fell back to round 1's checkpoint (restoring its history) and replayed
+    # round 2 instead of raising.
+    assert log.count(CHECKPOINT_FALLBACK) >= 1
+    assert [h["round"] for h in history] == [0, 1, 2]
+    ckpt2.wait()
+    assert ckpt2.latest_round() == 2
+
+
+def test_restore_returns_none_when_all_steps_corrupt(tmp_path):
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+    import jax.numpy as jnp
+
+    log = ResilienceLog()
+    ckpt = RoundCheckpointer(str(tmp_path / "ck"), max_to_keep=2, log=log)
+    states = {"pop": {"w": jnp.ones((3,))}}
+    ckpt.save(0, states, {}, [{"round": 0}])
+    ckpt.wait()
+    _corrupt_step_dir(str(tmp_path / "ck"), 0)
+    assert ckpt.restore(states, {}) is None
+    assert log.count(CHECKPOINT_FALLBACK) == 1
+
+
+# --------------------------------------------- TaskManager recover satellite
+def test_taskmgr_recover_running_rows_never_silently_lost():
+    from olearning_sim_tpu.taskmgr.status import TaskStatus
+    from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+    from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+
+    repo = TaskTableRepo()
+    # A RUNNING row with no frozen resources: the process died inside the
+    # launch window. Must be marked failed/interrupted, never left RUNNING.
+    repo.add_task("zombie", task_status=TaskStatus.RUNNING.name,
+                  task_params="{}")
+    # A RUNNING row with frozen resources: released and failed.
+    repo.add_task("occupied", task_status=TaskStatus.RUNNING.name,
+                  task_params="{}", resource_occupied="1")
+    TaskManager(task_repo=repo, schedule_interval=3600)
+    for task_id in ("zombie", "occupied"):
+        assert repo.get_item_value(task_id, "task_status") == TaskStatus.FAILED.name
+        assert repo.get_item_value(task_id, "task_finished_time")
+    assert repo.get_item_value("occupied", "resource_occupied") == "0"
+
+
+def test_taskmgr_recover_requeues_queued_rows():
+    import tests.test_taskmgr as tt
+    from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+    from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+    from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+
+    repo = TaskTableRepo()
+    mgr = TaskManager(task_repo=repo, schedule_interval=3600)
+    mgr.submit_task(json2taskconfig(tt.make_task_json("q1")))
+    mgr.submit_task(json2taskconfig(tt.make_task_json("q2")))
+    # Crash-restart: a fresh manager re-queues in in_queue_time order.
+    mgr2 = TaskManager(task_repo=repo, schedule_interval=3600)
+    assert mgr2.get_task_queue() == ["q1", "q2"]
+
+
+def test_taskmgr_resilience_digest_surface():
+    from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+    from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+
+    repo = TaskTableRepo()
+    log = ResilienceLog()
+    mgr = TaskManager(task_repo=repo, schedule_interval=3600,
+                      resilience_log=log)
+    repo.add_task("t-res")
+    # Runner-persisted blob wins when present.
+    repo.set_item_value("t-res", "resilience",
+                        json.dumps({"counters": {"retry": 3}}))
+    assert mgr.get_resilience("t-res")["counters"]["retry"] == 3
+    # Otherwise the live log answers.
+    repo.add_task("t-live")
+    log.record(RETRY, point="x", task_id="t-live")
+    assert mgr.get_resilience("t-live")["counters"][RETRY] == 1
