@@ -377,6 +377,9 @@ def main():
     _PRINTED_RESULT = True
 
     if fast:
+        # The smoke config still flushes the registry (the overhead
+        # comparison vs OLS_TELEMETRY=0 reads this artifact).
+        _dump_telemetry()
         return
 
     budget = DEGRADED_BUDGET_S if degraded else TOTAL_BUDGET_S
@@ -409,6 +412,21 @@ def main():
                 record = {"family": fam["name"], "error": str(e)[-500:]}
         record = _with_provenance(record, nominal, backend, degraded)
         _merge_suite(record)
+
+    _dump_telemetry()
+
+
+def _dump_telemetry():
+    """Flush the live metrics registry as a bench artifact (counters,
+    gauges, per-phase histograms from in-process runs). Never fatal."""
+    try:
+        from olearning_sim_tpu.telemetry import dump_json
+
+        dump_json(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_metrics.json"
+        ))
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the bench
+        print(f"telemetry snapshot dump failed: {e}", file=sys.stderr)
 
 
 def _with_provenance(record, nominal, backend, degraded):

@@ -51,16 +51,20 @@ class RoundCheckpointer:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 retry_policy=None, log=None, task_id: str = ""):
+                 retry_policy=None, log=None, task_id: str = "",
+                 registry=None):
         """``retry_policy`` — optional
         :class:`~olearning_sim_tpu.resilience.RetryPolicy` applied to save
         and per-step restore I/O (transient store hiccups); ``log`` — the
-        resilience event sink (defaults to the process-global log)."""
+        resilience event sink (defaults to the process-global log);
+        ``registry`` — telemetry sink for save/restore bytes+latency
+        (defaults to the process default registry)."""
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.retry_policy = retry_policy
         self.log = log
         self.task_id = task_id
+        self.registry = registry
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -85,11 +89,16 @@ class RoundCheckpointer:
              force: bool = False) -> None:
         """``force=True`` overwrites an existing step — the rollback-replay
         path re-saves rounds it re-executes."""
+        import time
+
+        from olearning_sim_tpu.telemetry import instrument
+
         payload = {
             "states": _strip_keys(states),
             "personal": _strip_keys(personal),
         }
         meta = {"round_idx": int(round_idx), "history": _jsonable(history)}
+        t0 = time.perf_counter()
         self._call(
             "checkpoint.save",
             self._mgr.save,
@@ -100,6 +109,14 @@ class RoundCheckpointer:
             ),
             force=force,
         )
+        instrument("ols_checkpoint_save_duration_seconds",
+                   self.registry).labels(
+            task_id=self.task_id
+        ).observe(time.perf_counter() - t0)
+        instrument("ols_checkpoint_save_bytes_total",
+                   self.registry).labels(
+            task_id=self.task_id
+        ).inc(_tree_bytes(payload))
         self._maybe_corrupt(round_idx)
 
     def _maybe_corrupt(self, round_idx: int) -> None:
@@ -164,19 +181,39 @@ class RoundCheckpointer:
                 ocp.utils.to_shape_dtype_struct, _strip_keys(template_personal)
             ),
         }
+        import time
+
+        from olearning_sim_tpu.telemetry import instrument
+
         log = self.log if self.log is not None else global_log()
         for step in steps:
+            t0 = time.perf_counter()
             try:
-                restored = self._call(
-                    "checkpoint.restore",
-                    self._mgr.restore,
-                    step,
-                    args=ocp.args.Composite(
-                        tree=ocp.args.StandardRestore(abstract),
-                        meta=ocp.args.JsonRestore(),
-                    ),
-                )
+                try:
+                    restored = self._call(
+                        "checkpoint.restore",
+                        self._mgr.restore,
+                        step,
+                        args=ocp.args.Composite(
+                            tree=ocp.args.StandardRestore(abstract),
+                            meta=ocp.args.JsonRestore(),
+                        ),
+                    )
+                finally:
+                    # Per ATTEMPTED step — a slow failed read during
+                    # corrupt-checkpoint fallback is exactly the latency
+                    # worth seeing.
+                    instrument(
+                        "ols_checkpoint_restore_duration_seconds",
+                        self.registry,
+                    ).labels(task_id=self.task_id).observe(
+                        time.perf_counter() - t0
+                    )
                 tree, meta = restored["tree"], restored["meta"]
+                instrument("ols_checkpoint_restore_bytes_total",
+                           self.registry).labels(
+                    task_id=self.task_id
+                ).inc(_tree_bytes(tree))
                 states = _rewrap_keys(tree["states"], template_states)
                 personal = _rewrap_keys(tree["personal"], template_personal)
                 return (int(meta["round_idx"]), states, personal,
@@ -219,6 +256,12 @@ class RoundCheckpointer:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+def _tree_bytes(tree) -> int:
+    """Payload size of a pytree of arrays (device or host)."""
+    return sum(int(getattr(leaf, "nbytes", 0) or 0)
+               for leaf in jax.tree.leaves(tree))
 
 
 def _jsonable(obj):

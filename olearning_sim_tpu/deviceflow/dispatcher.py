@@ -90,6 +90,13 @@ class Dispatcher:
         (reference ``release_dispatch`` flag, ``dispatcher.py:47-58``)."""
         self._release.set()
 
+    def _count_drop(self, n: int) -> None:
+        if n:
+            from olearning_sim_tpu.telemetry import instrument
+
+            instrument("ols_deviceflow_dropped_messages_total").inc(n)
+            self.dropped += n
+
     def _poll_wait(self) -> None:
         """Wait for messages to arrive: real time, NOT the schedule clock —
         under a VirtualClock a virtual-time poll would busy-spin the CPU and
@@ -115,7 +122,16 @@ class Dispatcher:
 
     def _send(self, batch: List[Any]) -> None:
         if batch:
+            from olearning_sim_tpu.telemetry import instrument
+
+            t0 = time.perf_counter()
             self.producer(batch)
+            instrument(
+                "ols_deviceflow_dispatch_batch_duration_seconds"
+            ).observe(time.perf_counter() - t0)
+            instrument("ols_deviceflow_dispatched_messages_total").inc(
+                len(batch)
+            )
             self.sent += len(batch)
 
     def _dispatch_real_time(self, plan: RealTimePlan) -> None:
@@ -129,7 +145,7 @@ class Dispatcher:
             got = self.shelf_room.take_from_shelf(self.flow_id, target - len(pending))
             for payload in got:
                 if plan.drop_probability > 0 and self.rng.random() < plan.drop_probability:
-                    self.dropped += 1
+                    self._count_drop(1)
                 else:
                     pending.append(payload)
             if len(pending) >= target:
@@ -163,7 +179,7 @@ class Dispatcher:
                     self._poll_wait()
             drop_set = set(drops)
             batch = [p for i, p in enumerate(collected) if i not in drop_set]
-            self.dropped += len(collected) - len(batch)
+            self._count_drop(len(collected) - len(batch))
             self._send(batch)
             if self.released and self.shelf_room.shelf_size(self.flow_id) == 0:
                 # No more messages can arrive (sorter rejects post-complete);

@@ -90,6 +90,34 @@ class DeviceFlowService:
         # the watermark so a post-recovery notify_complete waits for them.
         self._enqueued_count = self.inbound.qsize() if self.durable else 0
         self._sorted_count = 0
+        # flow_id -> batches this service parked on its durable shelf (feeds
+        # the parked-batch gauge; entries retire when the flow releases).
+        self._parked_batches: Dict[str, int] = {}
+        self._gauges_stamp = 0.0
+
+    def _update_queue_gauges(self) -> None:
+        """Refresh the inbound/shelf depth gauges from authoritative room
+        state (called from publish and the dispatch loop's poll tick).
+        Throttled to one refresh per poll interval: durable rooms answer
+        sizes with sqlite COUNTs, which a hot publish path must not pay per
+        message — the dispatch loop's tick keeps the gauge fresh anyway."""
+        from olearning_sim_tpu.telemetry import default_registry, instrument
+
+        if not default_registry().enabled:
+            # The registry-off overhead baseline must skip the value
+            # computation too (sqlite COUNTs per flow), not just the set().
+            return
+        now = time.monotonic()
+        if now - self._gauges_stamp < self.poll_interval:
+            return
+        self._gauges_stamp = now
+        gauge = instrument("ols_deviceflow_queue_depth")
+        gauge.labels(room="inbound").set(self.inbound.qsize())
+        with self._lock:
+            shelf_total = sum(
+                self.shelf_room.shelf_size(fid) for fid in self.flow
+            )
+        gauge.labels(room="shelf").set(shelf_total)
 
     def _default_outbound(self, flow_id: str, cfg: Dict[str, Any]):
         """Dispatch on the flow's outbound_service config: network types
@@ -208,6 +236,10 @@ class DeviceFlowService:
         with self._lock:
             self._enqueued_count += 1
         self.inbound.put(Message(routing_key, compute_resource, payload))
+        from olearning_sim_tpu.telemetry import instrument
+
+        instrument("ols_deviceflow_inbound_messages_total").inc()
+        self._update_queue_gauges()
 
     def check_dispatch_finished(self, task_id: str) -> bool:
         """Reference ``CheckDeviceflowDispatchFinished``
@@ -307,6 +339,7 @@ class DeviceFlowService:
                                 # graceful release drops them (counted).
                                 if _park is not None:
                                     _park(_fid)
+                                    self._note_parked(_fid)
                                 return
                             _ack(_fid)
                     disp = Dispatcher(
@@ -336,7 +369,27 @@ class DeviceFlowService:
                     )
                     t.start()
                     self._dispatch_threads[flow_id] = t
+            self._update_queue_gauges()
             self._stop.wait(self.poll_interval)
+
+    def _note_parked(self, flow_id: str) -> None:
+        """One more degraded batch parked on the durable shelf: the gauge
+        counts batches awaiting crash redelivery until their flow releases
+        (a graceful release drops them — close_shelf — so the gauge retires
+        with the flow)."""
+        from olearning_sim_tpu.telemetry import instrument
+
+        with self._lock:
+            self._parked_batches[flow_id] = \
+                self._parked_batches.get(flow_id, 0) + 1
+        instrument("ols_deviceflow_parked_batches").inc()
+
+    def _retire_parked(self, flow_id: str) -> None:
+        from olearning_sim_tpu.telemetry import instrument
+
+        n = self._parked_batches.pop(flow_id, 0)
+        if n:
+            instrument("ols_deviceflow_parked_batches").dec(n)
 
     def _run_dispatch(self, flow_id: str, disp: Dispatcher) -> None:
         try:
@@ -376,6 +429,7 @@ class DeviceFlowService:
                     self.flow_manager.persist(flow_id, params["task_id"], params)
                     self.flow_manager.release_flow(flow_id)
                     self.shelf_room.close_shelf(flow_id)
+                    self._retire_parked(flow_id)
                     del self._dispatch_threads[flow_id]
                     del self._dispatchers[flow_id]
                     del self.flow[flow_id]

@@ -849,7 +849,7 @@ class FedCore:
                     f"variates; pass control=core.init_control(state, "
                     f"ds.num_clients)"
                 )
-            return self._round_step(
+            return self._launch(
                 state, control, ds.x, ds.y, ds.num_samples, num_steps,
                 ds.client_uid, weight, jnp.float32(ds.population),
             )
@@ -864,7 +864,7 @@ class FedCore:
                     f"algorithm {self.algorithm.name!r} is personalized; pass "
                     f"personal=core.init_personal(state, ds.num_clients)"
                 )
-            return self._round_step(
+            return self._launch(
                 state, personal, ds.x, ds.y, ds.num_samples, num_steps,
                 ds.client_uid, weight,
             )
@@ -873,9 +873,34 @@ class FedCore:
                 f"algorithm {self.algorithm.name!r} is not personalized but "
                 f"personal state was supplied"
             )
-        return self._round_step(
+        return self._launch(
             state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid, weight
         )
+
+    def _launch(self, *args):
+        """Launch the compiled round step, counting launches and host-side
+        dispatch latency (async — device completion is the runner's
+        ``host_transfer`` phase). The first launch pays synchronous
+        trace+compile (seconds to minutes) and is excluded from the
+        dispatch histogram — one compile sample would dominate its sum
+        forever; the runner records compile time distinctly."""
+        import time
+
+        from olearning_sim_tpu.telemetry import instrument
+
+        t0 = time.perf_counter()
+        out = self._round_step(*args)
+        name = self.algorithm.name
+        instrument("ols_fedcore_round_steps_total").labels(
+            algorithm=name
+        ).inc()
+        if getattr(self, "_dispatch_warm", False):
+            instrument("ols_fedcore_round_step_dispatch_seconds").labels(
+                algorithm=name
+            ).observe(time.perf_counter() - t0)
+        else:
+            self._dispatch_warm = True
+        return out
 
     # ----------------------------------------------------------------- eval
     def _build_evaluate(self):

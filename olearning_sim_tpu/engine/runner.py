@@ -128,6 +128,8 @@ class SimulationRunner:
         model_io: Optional[Any] = None,
         warm_start_path: Optional[str] = None,
         resilience: Optional[ResilienceConfig] = None,
+        registry: Optional[Any] = None,
+        tracer: Optional[Any] = None,
     ):
         """``model_io`` — a :class:`ModelUpdateExporter` realizing the
         reference's model-update-style convention (round r's global model
@@ -136,7 +138,9 @@ class SimulationRunner:
         round-0 initial model fetched through ``model_io``'s repo
         (``Model.modelPath`` with ``useModel``). ``resilience`` — opt-in
         resilient round execution (None keeps the pre-resilience fail-fast
-        behavior bit-for-bit)."""
+        behavior bit-for-bit). ``registry`` / ``tracer`` — telemetry sinks
+        (:mod:`olearning_sim_tpu.telemetry`); None resolves the process
+        defaults at use time."""
         self.task_id = task_id
         self.core = core
         self.populations = populations
@@ -151,6 +155,14 @@ class SimulationRunner:
         self.checkpointer = checkpointer  # RoundCheckpointer (optional)
         self.checkpoint_every = max(1, int(checkpoint_every))
         self.perf = perf  # PerformanceManager (optional)
+        self.registry = registry  # telemetry MetricsRegistry (optional)
+        self.tracer = tracer  # telemetry SpanTracer (optional)
+        # Operators whose round step has executed once for this task: the
+        # first execution's wall time is the compile-dominated one and lands
+        # in the distinct ols_engine_compile_duration_seconds gauge. Keyed
+        # by operator only — a second population's (possibly cache-hit)
+        # first execution must not overwrite the real compile time.
+        self._compiled_once: set = set()
         self.model_io = model_io
         self.warm_start_path = warm_start_path
         if warm_start_path and model_io is None:
@@ -308,64 +320,104 @@ class SimulationRunner:
         if not ok:
             raise RuntimeError(f"deviceflow NotifyComplete failed for {routing_key}: {msg}")
 
+    # -------------------------------------------------------------- telemetry
+    @contextlib.contextmanager
+    def _phase(self, operator_name: str, phase: str, round_idx: int):
+        """Span + per-phase latency histogram around one round phase."""
+        from olearning_sim_tpu.telemetry import default_tracer, instrument
+
+        tracer = self.tracer if self.tracer is not None else default_tracer()
+        t0 = time.perf_counter()
+        with tracer.span(f"round.{operator_name}.{phase}",
+                         task_id=self.task_id, round_idx=round_idx):
+            yield
+        instrument(
+            "ols_engine_round_phase_duration_seconds", self.registry
+        ).labels(
+            task_id=self.task_id, operator=operator_name, phase=phase
+        ).observe(time.perf_counter() - t0)
+
     # -------------------------------------------------------------- operators
     def _run_train(self, p: DataPopulation, round_idx: int,
                    operator: OperatorSpec) -> Dict[str, Any]:
-        # Compile over REAL clients only — released slots must never be
-        # spent on zero-weight padding clients (which would silently shrink
-        # effective participation).
-        trace = compile_trace(
-            json.loads(operator.deviceflow_strategy) if (
-                operator.use_deviceflow and operator.deviceflow_strategy
-            ) else None,
-            p.dataset.num_real_clients,
-            round_idx,
-            task_id=self.task_id,
-            operator=operator.name,
-            seed=self.trace_seed,
-        )
-        real = p.dataset.num_real_clients
-        mask = np.zeros(p.dataset.num_clients, trace.participate.dtype)
-        mask[:real] = trace.participate
-        if self._quarantine is not None:
-            # Quarantined clients are masked out exactly like churned-out
-            # devices: zero weight, zero contribution, compiled program
-            # unchanged.
-            mask[:real] = mask[:real] * self._quarantine.active_mask(
-                p.name, real
-            ).astype(mask.dtype)
-        participate = global_put(mask, self.core.plan.client_sharding())
-        num_steps = None
-        if p.num_steps is not None:
-            num_steps = global_put(
-                np.asarray(p.num_steps, np.int32),
-                self.core.plan.client_sharding(),
+        from olearning_sim_tpu.telemetry import instrument
+
+        with self._phase(operator.name, "select", round_idx):
+            # Compile over REAL clients only — released slots must never be
+            # spent on zero-weight padding clients (which would silently
+            # shrink effective participation).
+            trace = compile_trace(
+                json.loads(operator.deviceflow_strategy) if (
+                    operator.use_deviceflow and operator.deviceflow_strategy
+                ) else None,
+                p.dataset.num_real_clients,
+                round_idx,
+                task_id=self.task_id,
+                operator=operator.name,
+                seed=self.trace_seed,
             )
-        state = self.states[p.name]
-        if self.core.algorithm.personalized:
-            personal = self.personal_states.get(p.name)
-            if personal is None:
-                personal = self.core.init_personal(state, p.dataset.num_clients)
-            state, metrics, personal = self.core.round_step(
-                state, p.dataset, participate=participate, personal=personal,
-                num_steps=num_steps,
+            real = p.dataset.num_real_clients
+            mask = np.zeros(p.dataset.num_clients, trace.participate.dtype)
+            mask[:real] = trace.participate
+            if self._quarantine is not None:
+                # Quarantined clients are masked out exactly like churned-out
+                # devices: zero weight, zero contribution, compiled program
+                # unchanged.
+                mask[:real] = mask[:real] * self._quarantine.active_mask(
+                    p.name, real
+                ).astype(mask.dtype)
+            participate = global_put(mask, self.core.plan.client_sharding())
+            num_steps = None
+            if p.num_steps is not None:
+                num_steps = global_put(
+                    np.asarray(p.num_steps, np.int32),
+                    self.core.plan.client_sharding(),
+                )
+        t_step0 = time.perf_counter()
+        with self._phase(operator.name, "train", round_idx):
+            state = self.states[p.name]
+            if self.core.algorithm.personalized:
+                personal = self.personal_states.get(p.name)
+                if personal is None:
+                    personal = self.core.init_personal(
+                        state, p.dataset.num_clients
+                    )
+                state, metrics, personal = self.core.round_step(
+                    state, p.dataset, participate=participate,
+                    personal=personal, num_steps=num_steps,
+                )
+                self.personal_states[p.name] = personal
+            elif self.core.algorithm.control_variates:
+                control = self.control_states.get(p.name)
+                if control is None:
+                    control = self.core.init_control(
+                        state, p.dataset.num_clients
+                    )
+                state, metrics, control = self.core.round_step(
+                    state, p.dataset, participate=participate,
+                    control=control, num_steps=num_steps,
+                )
+                self.control_states[p.name] = control
+            else:
+                state, metrics = self.core.round_step(
+                    state, p.dataset, participate=participate,
+                    num_steps=num_steps
+                )
+            self.states[p.name] = state
+        with self._phase(operator.name, "host_transfer", round_idx):
+            # The device_get is the host sync point: "train" above measures
+            # async dispatch; this interval covers real device execution.
+            client_loss = np.asarray(jax.device_get(metrics.client_loss))
+        if operator.name not in self._compiled_once:
+            # First execution of the compiled round step for this operator:
+            # wall time is compile-dominated and is recorded distinctly so
+            # steady-state latency stays unpolluted.
+            self._compiled_once.add(operator.name)
+            instrument(
+                "ols_engine_compile_duration_seconds", self.registry
+            ).labels(task_id=self.task_id, operator=operator.name).set(
+                time.perf_counter() - t_step0
             )
-            self.personal_states[p.name] = personal
-        elif self.core.algorithm.control_variates:
-            control = self.control_states.get(p.name)
-            if control is None:
-                control = self.core.init_control(state, p.dataset.num_clients)
-            state, metrics, control = self.core.round_step(
-                state, p.dataset, participate=participate, control=control,
-                num_steps=num_steps,
-            )
-            self.control_states[p.name] = control
-        else:
-            state, metrics = self.core.round_step(
-                state, p.dataset, participate=participate, num_steps=num_steps
-            )
-        self.states[p.name] = state
-        client_loss = np.asarray(jax.device_get(metrics.client_loss))
         ok = np.isfinite(client_loss)
         if self._quarantine is not None:
             # Strikes accrue only for clients that actually participated and
@@ -799,6 +851,11 @@ class SimulationRunner:
                 round_idx=round_idx,
                 error=f"{type(error).__name__}: {str(error)[:200]}",
             )
+            from olearning_sim_tpu.telemetry import instrument
+
+            instrument("ols_engine_rounds_total", self.registry).labels(
+                task_id=self.task_id, status="skipped"
+            ).inc()
             self.history.append({
                 "round": round_idx, "skipped": True,
                 "error": f"{type(error).__name__}: {str(error)[:200]}",
@@ -848,6 +905,9 @@ class SimulationRunner:
         """One full round: barriers, operators, accounting, checkpoint,
         model export. Returns "ok", "stop" (cooperative stop observed), or
         "final" (final-round stop barrier tolerated)."""
+        from olearning_sim_tpu.telemetry import default_tracer, instrument
+
+        tracer = self.tracer if self.tracer is not None else default_tracer()
         if not self.operator_flow.start():
             if self.stop_event is not None and self.stop_event.is_set():
                 return "stop"  # barrier abandoned due to stop request
@@ -883,27 +943,37 @@ class SimulationRunner:
                 local_steps=self.core.config.max_local_steps,
                 total_client_steps=total_steps,
             ) if self.perf is not None else contextlib.nullcontext()
-            with timer:
+            with timer, tracer.span(
+                f"round.{operator.name}", task_id=self.task_id,
+                round_idx=round_idx, kind=operator.kind,
+            ):
                 for p in self.populations:
                     if operator.kind == "train":
                         r = self._run_train(p, round_idx, operator)
                         ok_by_population[p.name] = r.pop("ok_mask")
                     elif operator.kind == "eval":
-                        r = self._run_eval(p)
+                        with self._phase(operator.name, "eval", round_idx):
+                            r = self._run_eval(p)
                         ok_by_population[p.name] = np.ones(
                             p.dataset.num_clients, bool
                         )
                     elif operator.kind == "custom":
-                        r = self._call_custom(operator, round_idx, p) or {}
+                        with self._phase(operator.name, "custom", round_idx):
+                            r = self._call_custom(operator, round_idx, p) or {}
                         ok_by_population[p.name] = r.pop(
                             "ok_mask", np.ones(p.dataset.num_clients, bool)
                         )
                     else:
                         raise ValueError(f"unknown operator kind {operator.kind!r}")
                     op_record[p.name] = r
+            if operator.kind == "train" and nc:
+                instrument(
+                    "ols_engine_device_rounds_total", self.registry
+                ).labels(task_id=self.task_id).inc(nc)
             self._flow_complete(routing_key)
             self._live_routing_key = None
-            self._analyze_results(operator, round_idx, ok_by_population)
+            with self._phase(operator.name, "accounting", round_idx):
+                self._analyze_results(operator, round_idx, ok_by_population)
             round_record[operator.name] = op_record
             self._round_outputs[operator.name] = op_record
 
@@ -913,17 +983,19 @@ class SimulationRunner:
         # checkpoint-rollback path must absorb.
         faults.inject("runner.pre_checkpoint", context=str(round_idx),
                       round_idx=round_idx, task_id=self.task_id)
-        self._checkpoint(round_idx)
+        with self._phase("round", "checkpoint", round_idx):
+            self._checkpoint(round_idx)
         if self.model_io is not None and not self._model_io_export_dead:
             # One global model per task (reference convention); multi-
             # population tasks export the first population's.
             try:
-                self.model_io.export(
-                    round_idx,
-                    self._host_params(
-                        self.states[self.populations[0].name].params
-                    ),
-                )
+                with self._phase("round", "model_export", round_idx):
+                    self.model_io.export(
+                        round_idx,
+                        self._host_params(
+                            self.states[self.populations[0].name].params
+                        ),
+                    )
             except NotImplementedError as e:
                 # Download-only repo (HTTP warm start): ingestion works,
                 # export cannot — disable it once, loudly.
@@ -1001,6 +1073,11 @@ class SimulationRunner:
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # noqa: BLE001 — policy dispatch
+                from olearning_sim_tpu.telemetry import instrument
+
+                instrument("ols_engine_rounds_total", self.registry).labels(
+                    task_id=self.task_id, status="failed"
+                ).inc()
                 self._abandon_live_flow()
                 action, next_round, new_attempts = self._handle_round_failure(
                     round_idx, retries.get(round_idx, 0), e
@@ -1013,6 +1090,19 @@ class SimulationRunner:
                 flow_epoch += 1
                 continue
             retries.pop(round_idx, None)
+            # "ok" means the round's work completed: always true for
+            # "ok"/"final"; true for "stop" only when the stop barrier was
+            # abandoned AFTER the operators ran (history got the record) —
+            # a stop at the START barrier executed nothing and counts as
+            # no round at all.
+            if status != "stop" or (
+                self.history and self.history[-1].get("round") == round_idx
+            ):
+                from olearning_sim_tpu.telemetry import instrument
+
+                instrument("ols_engine_rounds_total", self.registry).labels(
+                    task_id=self.task_id, status="ok"
+                ).inc()
             if self._quarantine is not None:
                 self._qsnapshots[round_idx] = self._quarantine.snapshot()
                 # Retention must cover the deepest possible rollback: a

@@ -9,8 +9,11 @@ execution, not async dispatch).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
+import math
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -58,32 +61,61 @@ def _mean_step_latency(rows: List["RoundTiming"]) -> float:
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Linear interpolation between closest ranks (numpy's default): the
+    nearest-rank rounding this replaces biased p95 on small samples — 10
+    rounds' p95 answered the p100 (max) value."""
     if not sorted_vals:
         return 0.0
-    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[idx]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
 
 
 class PerformanceManager:
     """Records timings, answers performance queries, controls the profiler."""
 
     def __init__(self, repo: Optional[TableRepo] = None, keep_last: int = 4096,
-                 resilience_log=None):
+                 resilience_log=None, registry=None, tracer=None):
         # No repo by default: queries are answered from the bounded in-memory
         # window. Pass a repo to persist every row for external analysis —
         # retention is then the caller's policy (rows are append-only).
         # ``resilience_log`` — the ResilienceLog whose counters get_resilience
         # reports; pass the runner's instance when it is not the process-
         # global default (ResilienceConfig(log=...)).
+        # ``registry`` / ``tracer`` — telemetry sinks this manager fronts
+        # (None resolves the process defaults): every recorded timing also
+        # feeds the live metrics registry, and stop_trace flushes the
+        # tracer's runner spans next to the XLA trace. get_performance
+        # answers stay computed from the recorded RoundTiming rows
+        # themselves — the façade adds lenses, it never changes the numbers.
         self.repo = repo
         self.keep_last = keep_last
         self.resilience_log = resilience_log
+        self.registry = registry
+        self.tracer = tracer
         self._lock = threading.RLock()
         self._timings: Dict[str, List[RoundTiming]] = {}
+        # task_id -> monotonic time of the last repo rehydration scan: a
+        # monitoring loop polling an unknown task must not pay a full-table
+        # scan per poll, but rows another process appends later (shared
+        # sqlite repo) must still become visible — so misses retry after
+        # ``rehydrate_ttl_s`` instead of being cached forever.
+        self.rehydrate_ttl_s = 30.0
+        self._rehydrate_scans: Dict[str, float] = {}
         self._trace_dir: Optional[str] = None
+        self._trace_span_mark: float = 0.0
 
     # ------------------------------------------------------------- recording
     def record_round(self, timing: RoundTiming) -> None:
+        from olearning_sim_tpu.telemetry import instrument
+
+        instrument(
+            "ols_engine_round_duration_seconds", self.registry
+        ).labels(task_id=timing.task_id, operator=timing.operator).observe(
+            timing.duration_s
+        )
         with self._lock:
             rows = self._timings.setdefault(timing.task_id, [])
             rows.append(timing)
@@ -151,12 +183,66 @@ class PerformanceManager:
             log = global_log()
         return log.counters(task_id)
 
+    def _rehydrate(self, task_id: str) -> List[RoundTiming]:
+        """Rebuild a task's RoundTiming window from the persisted repo (a
+        restarted manager constructed over the same TableRepo must answer
+        for completed tasks, not ``rounds_recorded: 0``). Unparseable rows
+        are skipped — one corrupt row must not hide the rest."""
+        if self.repo is None:
+            return []
+        # Scan fully under the lock: a concurrent get_performance for the
+        # same task must wait and see the restored window, not race past a
+        # pre-stamped TTL and answer rounds_recorded: 0 mid-scan.
+        with self._lock:
+            rows = self._timings.get(task_id)
+            if rows:
+                return list(rows)
+            now = time.monotonic()
+            last = self._rehydrate_scans.get(task_id)
+            if last is not None and now - last < self.rehydrate_ttl_s:
+                return []
+            if len(self._rehydrate_scans) > 4096:
+                # Bound the stamp map: keep the freshest half (a monitoring
+                # loop cycling through many dead ids must not grow it
+                # forever).
+                for tid, _ in sorted(self._rehydrate_scans.items(),
+                                     key=lambda kv: kv[1])[:2048]:
+                    del self._rehydrate_scans[tid]
+            restored: List[RoundTiming] = []
+            for row in self.repo.query_all():
+                if row.get("task_id") != task_id:
+                    continue
+                try:
+                    extra = json.loads(row.get("extra") or "{}")
+                    restored.append(RoundTiming(
+                        task_id=task_id,
+                        round_idx=int(row.get("round_idx") or 0),
+                        operator=row.get("operator") or "",
+                        duration_s=float(row.get("duration_s") or 0.0),
+                        num_clients=int(row.get("num_clients") or 0),
+                        local_steps=int(row.get("local_steps") or 0),
+                        total_client_steps=int(
+                            extra.pop("total_client_steps", 0) or 0
+                        ),
+                        extra={k: v for k, v in extra.items()},
+                    ))
+                except (TypeError, ValueError):
+                    continue
+            self._rehydrate_scans[task_id] = time.monotonic()
+            if restored:
+                window = self._timings.setdefault(task_id, [])
+                window.extend(restored[-self.keep_last:])
+                restored = list(window)
+            return restored
+
     def get_performance(self, task_id: str) -> Dict[str, Any]:
         """Summary for one task: throughput + latency distribution
         (the ``PerformanceMgr.getPerformance`` answer)."""
         resilience = self.get_resilience(task_id)
         with self._lock:
             rows = list(self._timings.get(task_id, []))
+        if not rows:
+            rows = self._rehydrate(task_id)
         if not rows:
             return {"task_id": task_id, "rounds_recorded": 0,
                     "resilience": resilience}
@@ -185,18 +271,56 @@ class PerformanceManager:
         with self._lock:
             return sorted(self._timings)
 
+    # --------------------------------------------------------------- metrics
+    def render_metrics(self, fmt: str = "prometheus") -> str:
+        """The live metrics registry rendered for transport: Prometheus
+        text exposition (default) or a JSON snapshot — the body of the
+        PerformanceMgr ``getMetrics`` RPC."""
+        from olearning_sim_tpu.telemetry import render_prometheus, snapshot
+
+        if fmt in ("json", "snapshot"):
+            return json.dumps(snapshot(self.registry))
+        return render_prometheus(self.registry)
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Dict form of the registry (bench.py artifacts)."""
+        from olearning_sim_tpu.telemetry import snapshot
+
+        return snapshot(self.registry)
+
     # -------------------------------------------------------------- profiler
     def start_trace(self, logdir: str) -> bool:
         """Begin a ``jax.profiler`` trace (XLA op-level timeline viewable in
-        TensorBoard/Perfetto). One trace at a time."""
+        TensorBoard/Perfetto). One trace at a time. A start that raises
+        (unwritable logdir, half-initialized profiler session) leaves this
+        manager armed for the next attempt instead of wedged "in a trace"
+        forever."""
         import jax
+
+        from olearning_sim_tpu.telemetry import default_tracer
 
         with self._lock:
             if self._trace_dir is not None:
                 return False
-            jax.profiler.start_trace(logdir)
+            tracer = self.tracer if self.tracer is not None else \
+                default_tracer()
+            # Spans before this watermark belong to earlier rounds/traces
+            # and have no counterpart in the XLA capture starting now.
+            self._trace_span_mark = tracer.now()
+            try:
+                jax.profiler.start_trace(logdir)
+            except BaseException:
+                # jax may have partially opened a profiler session before
+                # failing; close it so the retry doesn't hit "already
+                # started".
+                self._trace_dir = None
+                with contextlib.suppress(Exception):
+                    jax.profiler.stop_trace()
+                raise
             self._trace_dir = logdir
             return True
+
+    RUNNER_SPAN_FILE = "runner_spans.trace.json"
 
     def stop_trace(self) -> Optional[str]:
         import jax
@@ -206,4 +330,13 @@ class PerformanceManager:
                 return None
             jax.profiler.stop_trace()
             out, self._trace_dir = self._trace_dir, None
-            return out
+        # Flush the runner-level spans as Perfetto trace_event JSON next to
+        # the XLA trace, so one directory opens both timelines. Best-effort:
+        # span export must never turn a successful XLA capture into an error.
+        from olearning_sim_tpu.telemetry import default_tracer
+
+        tracer = self.tracer if self.tracer is not None else default_tracer()
+        with contextlib.suppress(Exception):
+            tracer.export(os.path.join(out, self.RUNNER_SPAN_FILE),
+                          since_s=self._trace_span_mark)
+        return out

@@ -1,0 +1,76 @@
+"""Dynamically built protobuf messages for the PerformanceMgr getMetrics RPC.
+
+The image ships protoc without grpc_python_plugin, and regenerating
+``services_pb2.py`` is not possible in-container — so the two telemetry
+messages are built at import time from a ``FileDescriptorProto`` (exactly
+what protoc would emit, same wire format, same package). The source of
+truth for the schema is ``services.proto``'s ``MetricsQuery`` /
+``MetricsSnapshot`` comment block; keep both in sync.
+
+Messages:
+
+- ``MetricsQuery``: ``format`` ("prometheus" | "json"; empty = prometheus).
+- ``MetricsSnapshot``: ``content_type`` (the HTTP-style content type of the
+  rendered body) + ``body`` (the rendered registry).
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_FILE = "olearning_sim_tpu_telemetry.proto"
+_PACKAGE = "olearning_sim_tpu.services"
+
+
+def _build():
+    pool = descriptor_pool.Default()
+    try:
+        # If a regenerated services_pb2 already declared these messages (the
+        # proto source now carries them), reuse its descriptors — Add()ing a
+        # second file with the same symbols would raise at import time.
+        return (
+            message_factory.GetMessageClass(
+                pool.FindMessageTypeByName(f"{_PACKAGE}.MetricsQuery")
+            ),
+            message_factory.GetMessageClass(
+                pool.FindMessageTypeByName(f"{_PACKAGE}.MetricsSnapshot")
+            ),
+        )
+    except KeyError:
+        pass
+    try:
+        fd = pool.FindFileByName(_FILE)
+    except KeyError:
+        fdp = descriptor_pb2.FileDescriptorProto()
+        fdp.name = _FILE
+        fdp.package = _PACKAGE
+        fdp.syntax = "proto3"
+
+        query = fdp.message_type.add()
+        query.name = "MetricsQuery"
+        f = query.field.add()
+        f.name, f.number = "format", 1
+        f.type = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+        f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+        snap = fdp.message_type.add()
+        snap.name = "MetricsSnapshot"
+        for i, name in enumerate(("content_type", "body"), start=1):
+            f = snap.field.add()
+            f.name, f.number = name, i
+            f.type = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+            f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+        fd = pool.Add(fdp)
+    return (
+        message_factory.GetMessageClass(
+            fd.message_types_by_name["MetricsQuery"]
+        ),
+        message_factory.GetMessageClass(
+            fd.message_types_by_name["MetricsSnapshot"]
+        ),
+    )
+
+
+MetricsQuery, MetricsSnapshot = _build()
+
+__all__ = ["MetricsQuery", "MetricsSnapshot"]

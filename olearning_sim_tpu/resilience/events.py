@@ -61,10 +61,17 @@ class ResilienceLog:
     Counters are kept globally and per task id; the event list keeps the last
     ``keep_last`` entries (structured forensics), while counters are exact
     over the log's lifetime.
+
+    Every record is mirrored into the telemetry metrics registry as a
+    labeled ``ols_resilience_events_total{kind, task_id}`` increment, so the
+    Prometheus render of a run carries the same counters this log answers —
+    ``registry`` pins a specific :class:`MetricsRegistry`; None resolves the
+    process default at record time (so a test-swapped default is honored).
     """
 
-    def __init__(self, keep_last: int = 4096):
+    def __init__(self, keep_last: int = 4096, registry=None):
         self.keep_last = keep_last
+        self.registry = registry
         self._lock = threading.RLock()
         self._counters: Counter = Counter()
         self._task_counters: Dict[str, Counter] = {}
@@ -81,6 +88,11 @@ class ResilienceLog:
             self._events.append(ev)
             if len(self._events) > self.keep_last:
                 del self._events[: len(self._events) - self.keep_last]
+        from olearning_sim_tpu.telemetry import instrument
+
+        instrument("ols_resilience_events_total", self.registry).labels(
+            kind=kind, task_id=task_id
+        ).inc()
         return ev
 
     def counters(self, task_id: Optional[str] = None) -> Dict[str, int]:

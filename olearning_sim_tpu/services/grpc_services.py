@@ -16,6 +16,7 @@ import grpc
 from google.protobuf import empty_pb2
 
 from olearning_sim_tpu.proto import services_pb2 as spb
+from olearning_sim_tpu.proto import telemetry_pb2 as tpb
 
 
 def _methods_of(service_cls) -> Dict[str, Tuple[Type, Type]]:
@@ -481,6 +482,7 @@ class PerformanceMgrServicer:
     SERVICE_NAME = "olearning_sim_tpu.services.PerformanceMgr"
     METHODS = {
         "getPerformance": (spb.TaskRef, spb.PerformanceReport),
+        "getMetrics": (tpb.MetricsQuery, tpb.MetricsSnapshot),
         "startTrace": (spb.TraceRequest, spb.Ack),
         "stopTrace": (empty_pb2.Empty, spb.TraceRequest),
     }
@@ -492,6 +494,15 @@ class PerformanceMgrServicer:
         return spb.PerformanceReport(
             json_data=json.dumps(self.manager.get_performance(request.task_id))
         )
+
+    def getMetrics(self, request, context) -> tpb.MetricsSnapshot:
+        """Live telemetry registry, rendered: Prometheus text exposition by
+        default, JSON snapshot for ``format="json"``."""
+        fmt = (request.format or "prometheus").lower()
+        body = self.manager.render_metrics(fmt)
+        ctype = ("application/json" if fmt in ("json", "snapshot")
+                 else "text/plain; version=0.0.4; charset=utf-8")
+        return tpb.MetricsSnapshot(content_type=ctype, body=body)
 
     def startTrace(self, request, context) -> spb.Ack:
         return spb.Ack(is_success=self.manager.start_trace(request.logdir))
@@ -506,6 +517,11 @@ class PerformanceMgrClient(_ClientBase):
     def get_performance(self, task_id):
         r = self._calls["getPerformance"](spb.TaskRef(task_id=task_id))
         return json.loads(r.json_data)
+
+    def get_metrics(self, fmt: str = "prometheus"):
+        """Returns (content_type, rendered_body)."""
+        r = self._calls["getMetrics"](tpb.MetricsQuery(format=fmt))
+        return r.content_type, r.body
 
     def start_trace(self, logdir) -> bool:
         return self._calls["startTrace"](spb.TraceRequest(logdir=logdir)).is_success

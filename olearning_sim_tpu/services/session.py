@@ -47,11 +47,18 @@ class SimulatorSession:
         cluster_manager=None,
         performance_manager=None,
         max_workers: int = 16,
+        metrics_port: Optional[int] = None,
     ):
+        """``metrics_port`` — when set, start() also serves the telemetry
+        registry on ``127.0.0.1:<metrics_port>`` (``/metrics`` Prometheus
+        text, ``/metrics.json`` snapshot; 0 binds an ephemeral port,
+        readable from ``session.metrics_server.port``)."""
         self.services = tuple(services)
         self.address = address
         self._server: Optional[grpc.Server] = None
         self.port: Optional[int] = None
+        self.metrics_port = metrics_port
+        self.metrics_server = None
 
         if "resourcemgr" in self.services and resource_manager is None:
             from olearning_sim_tpu.resourcemgr.resource_manager import ResourceManager
@@ -118,12 +125,22 @@ class SimulatorSession:
         self.port = server.add_insecure_port(self.address)
         server.start()
         self._server = server
+        if self.metrics_port is not None and self.metrics_server is None:
+            from olearning_sim_tpu.telemetry import MetricsHTTPServer
+
+            registry = getattr(self.performance_manager, "registry", None)
+            self.metrics_server = MetricsHTTPServer(
+                registry=registry, port=self.metrics_port
+            ).start()
         return server, self.port
 
     def stop(self, grace: float = 1.0) -> None:
         if self._server is not None:
             self._server.stop(grace)
             self._server = None
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         if self.task_manager is not None and hasattr(self.task_manager, "stop"):
             self.task_manager.stop()
         if self.deviceflow is not None and hasattr(self.deviceflow, "stop"):

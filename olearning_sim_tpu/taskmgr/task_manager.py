@@ -243,13 +243,24 @@ class TaskManager:
             repo.set_item_value(task_id, "in_queue_time", time.strftime("%Y-%m-%d %H:%M:%S"))
             repo.set_item_value(task_id, "resource_occupied", "0")
             self._task_queue.add(tc)
+            self._update_queue_gauge()
             return True
+
+    def _update_queue_gauge(self) -> None:
+        from olearning_sim_tpu.telemetry import default_registry, instrument
+
+        if not default_registry().enabled:
+            return
+        instrument("ols_taskmgr_queue_depth").set(
+            len(self._task_queue.get_task_ids())
+        )
 
     def stop_task(self, task_id: str) -> bool:
         """Reference ``stop_task`` (``task_manager.py:358-455``)."""
         with self._lock:
             if task_id in self._task_queue:
                 self._task_queue.delete(task_id)
+                self._update_queue_gauge()
                 self._task_repo.set_item_value(task_id, "task_status", TaskStatus.STOPPED.name)
                 return True
             job_id = self._task_repo.get_item_value(task_id, "job_id")
@@ -522,6 +533,7 @@ class TaskManager:
             if not self._task_queue.delete(task_id):
                 # stop_task removed it between snapshot and here
                 return None
+            self._update_queue_gauge()
             self._submit_scheduled(result)
         return task_id
 

@@ -53,13 +53,31 @@ class TaskTableRepo:
         item = {"task_id": [task_id]}
         for k, v in fields.items():
             item[k] = [v]
-        return self.backend.add_item(item)
+        ok = self.backend.add_item(item)
+        if ok and "task_status" in fields:
+            self._count_transition(fields["task_status"])
+        return ok
 
     def get_item_value(self, task_id: str, item: str) -> Any:
         return self.backend.get_item_value("task_id", task_id, item)
 
     def set_item_value(self, task_id: str, item: str, value: Any) -> bool:
-        return self.backend.set_item_value("task_id", task_id, item, value)
+        # The single seam every task_status write goes through (submit,
+        # schedule, stop, release, recover, watchdog) — counted here, and
+        # only for writes the backend actually landed (a write racing a
+        # deleted row must not count as a transition).
+        ok = self.backend.set_item_value("task_id", task_id, item, value)
+        if ok and item == "task_status":
+            self._count_transition(value)
+        return ok
+
+    @staticmethod
+    def _count_transition(status: Any) -> None:
+        from olearning_sim_tpu.telemetry import instrument
+
+        instrument("ols_taskmgr_state_transitions_total").labels(
+            status=str(status)
+        ).inc()
 
     def delete_task(self, task_id: str) -> bool:
         return self.backend.delete_items(task_id=task_id)

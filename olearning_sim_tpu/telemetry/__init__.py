@@ -1,0 +1,211 @@
+"""Unified telemetry: metrics registry, span tracing, exporters.
+
+Three layers, all stdlib-only:
+
+- :mod:`telemetry.metrics` — thread-safe ``Counter`` / ``Gauge`` /
+  ``Histogram`` behind a :class:`MetricsRegistry` (process default +
+  injectable instances);
+- :mod:`telemetry.tracing` — :class:`SpanTracer` producing parent-linked
+  wall-clock spans exportable as Chrome/Perfetto ``trace_event`` JSON (so
+  runner spans open next to ``jax.profiler`` XLA traces);
+- :mod:`telemetry.exporters` — Prometheus text exposition
+  (:func:`render_prometheus` + :class:`MetricsHTTPServer`) and JSON
+  snapshots (:func:`snapshot` / :func:`dump_json`) for bench artifacts.
+
+Every platform metric is declared once in :data:`CATALOG` below and
+materialized through :func:`instrument` — one definition point, so the
+exporters, the docs metric table, and ``scripts/check_metrics.py`` (the
+naming lint) can never drift from the instrumentation. Names follow
+``ols_<subsystem>_<noun>_<unit>``; counters end in ``_total``.
+
+Set ``OLS_TELEMETRY=0`` in the environment to start the process with the
+default registry disabled (every mutation short-circuits to one attribute
+check) — the bench's overhead baseline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from olearning_sim_tpu.telemetry.metrics import (
+    COUNTER,
+    DEFAULT_BUCKETS,
+    GAUGE,
+    HISTOGRAM,
+    Counter,
+    Gauge,
+    Histogram,
+    Metric,
+    MetricsRegistry,
+    default_registry,
+    set_default_registry,
+)
+from olearning_sim_tpu.telemetry.tracing import (
+    Span,
+    SpanTracer,
+    default_tracer,
+    set_default_tracer,
+)
+from olearning_sim_tpu.telemetry.exporters import (
+    MetricsHTTPServer,
+    dump_json,
+    render_prometheus,
+    snapshot,
+)
+
+# Round-phase latencies cluster well under a second on TPU but stretch to
+# minutes for first-round compiles; checkpoint I/O sits in between.
+_PHASE_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                  2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+_IO_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+               10.0, 30.0, 60.0)
+_DISPATCH_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                     1.0, 5.0)
+
+# name -> (kind, help, label names[, buckets]). THE metric catalog of
+# record: docs/observability.md renders this table and the naming lint
+# (scripts/check_metrics.py) validates it.
+CATALOG = {
+    # ------------------------------------------------------------- engine
+    "ols_engine_round_phase_duration_seconds": (
+        HISTOGRAM,
+        "Wall-clock per round phase (select/train/host_transfer/eval/"
+        "custom/accounting/checkpoint/model_export)",
+        ("task_id", "operator", "phase"), _PHASE_BUCKETS,
+    ),
+    "ols_engine_round_duration_seconds": (
+        HISTOGRAM,
+        "Wall-clock per (round, operator) execution as recorded by "
+        "PerformanceManager",
+        ("task_id", "operator"), _PHASE_BUCKETS,
+    ),
+    "ols_engine_compile_duration_seconds": (
+        GAUGE,
+        "First-execution wall-clock of the compiled round step per "
+        "(task, operator) — dominated by XLA compilation",
+        ("task_id", "operator"),
+    ),
+    "ols_engine_rounds_total": (
+        COUNTER,
+        "Round executions by outcome (ok/failed/skipped)",
+        ("task_id", "status"),
+    ),
+    "ols_engine_device_rounds_total": (
+        COUNTER,
+        "Virtual device-rounds advanced (clients x train rounds)",
+        ("task_id",),
+    ),
+    # ------------------------------------------------------------ fedcore
+    "ols_fedcore_round_steps_total": (
+        COUNTER,
+        "Compiled FedCore round-step launches (train aggregation included)",
+        ("algorithm",),
+    ),
+    "ols_fedcore_round_step_dispatch_seconds": (
+        HISTOGRAM,
+        "Host-side dispatch latency of the compiled round step (async "
+        "launch, not device completion)",
+        ("algorithm",), _DISPATCH_BUCKETS,
+    ),
+    # --------------------------------------------------------- checkpoint
+    "ols_checkpoint_save_duration_seconds": (
+        HISTOGRAM, "RoundCheckpointer.save wall-clock (dispatch side)",
+        ("task_id",), _IO_BUCKETS,
+    ),
+    "ols_checkpoint_restore_duration_seconds": (
+        HISTOGRAM, "RoundCheckpointer.restore wall-clock per attempted step",
+        ("task_id",), _IO_BUCKETS,
+    ),
+    "ols_checkpoint_save_bytes_total": (
+        COUNTER, "Payload bytes handed to checkpoint saves (leaf sizes)",
+        ("task_id",),
+    ),
+    "ols_checkpoint_restore_bytes_total": (
+        COUNTER, "Payload bytes restored from checkpoints (leaf sizes)",
+        ("task_id",),
+    ),
+    # --------------------------------------------------------- deviceflow
+    "ols_deviceflow_queue_depth": (
+        GAUGE,
+        "Staged messages by room (inbound queue / all shelves combined)",
+        ("room",),
+    ),
+    "ols_deviceflow_inbound_messages_total": (
+        COUNTER, "Messages published into the deviceflow inbound room", (),
+    ),
+    "ols_deviceflow_dispatched_messages_total": (
+        COUNTER, "Messages delivered to outbound producers", (),
+    ),
+    "ols_deviceflow_dropped_messages_total": (
+        COUNTER, "Messages dropped by dispatch behavior (drop schedule)", (),
+    ),
+    "ols_deviceflow_dispatch_batch_duration_seconds": (
+        HISTOGRAM, "Outbound producer latency per dispatched batch",
+        (), _DISPATCH_BUCKETS,
+    ),
+    "ols_deviceflow_parked_batches": (
+        GAUGE,
+        "Degraded outbound batches parked on durable shelves awaiting "
+        "crash redelivery",
+        (),
+    ),
+    # ------------------------------------------------------------ taskmgr
+    "ols_taskmgr_state_transitions_total": (
+        COUNTER, "Task status writes by destination state", ("status",),
+    ),
+    "ols_taskmgr_queue_depth": (
+        GAUGE, "Tasks waiting in the scheduler queue", (),
+    ),
+    # --------------------------------------------------------- resilience
+    "ols_resilience_events_total": (
+        COUNTER,
+        "Resilience events (retry/rollback/quarantine/...) mirrored from "
+        "ResilienceLog",
+        ("kind", "task_id"),
+    ),
+}
+
+
+def instrument(name: str, registry: Optional[MetricsRegistry] = None):
+    """Materialize a cataloged metric in ``registry`` (default registry when
+    None). Idempotent; the only way platform code should create metrics."""
+    spec = CATALOG[name]
+    kind, help_text, labels = spec[0], spec[1], spec[2]
+    registry = registry if registry is not None else default_registry()
+    if kind == HISTOGRAM:
+        buckets = spec[3] if len(spec) > 3 else DEFAULT_BUCKETS
+        return registry.histogram(name, help_text, labels=labels,
+                                  buckets=buckets)
+    if kind == GAUGE:
+        return registry.gauge(name, help_text, labels=labels)
+    return registry.counter(name, help_text, labels=labels)
+
+
+if os.environ.get("OLS_TELEMETRY") == "0":
+    default_registry().enabled = False
+    default_tracer().enabled = False
+
+__all__ = [
+    "CATALOG",
+    "COUNTER",
+    "GAUGE",
+    "HISTOGRAM",
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Metric",
+    "MetricsRegistry",
+    "MetricsHTTPServer",
+    "Span",
+    "SpanTracer",
+    "default_registry",
+    "default_tracer",
+    "dump_json",
+    "instrument",
+    "render_prometheus",
+    "set_default_registry",
+    "set_default_tracer",
+    "snapshot",
+]

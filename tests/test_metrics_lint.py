@@ -1,0 +1,46 @@
+"""Tier-1 wiring for scripts/check_metrics.py: the metric-name lint runs
+with the normal suite, so a PR cannot land an uncataloged or misnamed
+metric."""
+
+import os
+import sys
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+
+
+def _lint():
+    sys.path.insert(0, SCRIPTS)
+    try:
+        import check_metrics
+
+        return check_metrics
+    finally:
+        sys.path.remove(SCRIPTS)
+
+
+def test_registered_metric_names_pass_lint():
+    check_metrics = _lint()
+    problems = check_metrics.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_lint_catches_violations():
+    """The lint itself works: bad names / kinds are reported."""
+    from olearning_sim_tpu.telemetry import COUNTER, GAUGE, HISTOGRAM
+
+    check_metrics = _lint()
+    bad = {
+        "requests_total": (COUNTER, "no ols_ prefix", ()),
+        "ols_nosuchsubsystem_things_total": (COUNTER, "bad subsystem", ()),
+        "ols_engine_stuff": (GAUGE, "bad unit suffix", ()),
+        "ols_engine_retries": (COUNTER, "counter missing _total", ()),
+        "ols_engine_wait_total": (HISTOGRAM, "histogram not base unit", ()),
+    }
+    problems = check_metrics.check(catalog=bad)
+    assert len([p for p in problems if "not snake_case" in p
+                or "ols_" in p]) >= 1
+    joined = "\n".join(problems)
+    assert "unknown subsystem" in joined
+    assert "unit suffix" in joined
+    assert "counters must end in _total" in joined
+    assert "histograms must measure a base unit" in joined

@@ -86,13 +86,93 @@ def test_profiler_trace(tmp_path):
     import jax
     import jax.numpy as jnp
 
-    perf = PerformanceManager()
+    from olearning_sim_tpu.telemetry import SpanTracer
+
+    tracer = SpanTracer()
+    perf = PerformanceManager(tracer=tracer)
+    with tracer.span("before.window"):
+        pass  # predates the trace: must NOT appear in the flushed file
     logdir = str(tmp_path / "trace")
     assert perf.start_trace(logdir)
     assert not perf.start_trace(logdir)  # one at a time
-    jnp.square(jnp.arange(8.0)).block_until_ready()
+    with tracer.span("round.train", round_idx=0):
+        jnp.square(jnp.arange(8.0)).block_until_ready()
     assert perf.stop_trace() == logdir
     assert perf.stop_trace() is None
     # Trace artifacts were written.
     found = [f for _, _, fs in os.walk(logdir) for f in fs]
     assert found, "no trace files written"
+    # The runner-span Perfetto file landed next to the XLA trace.
+    span_file = os.path.join(logdir, PerformanceManager.RUNNER_SPAN_FILE)
+    assert os.path.exists(span_file)
+    import json as _json
+
+    with open(span_file) as f:
+        doc = _json.load(f)
+    assert any(ev["name"] == "round.train" for ev in doc["traceEvents"])
+    # Windowed: only spans inside this trace's interval are flushed.
+    assert not any(ev["name"] == "before.window" for ev in doc["traceEvents"])
+
+
+def test_percentile_linear_interpolation():
+    from olearning_sim_tpu.performancemgr.performance_manager import _percentile
+
+    vals = [1.0, 2.0, 3.0, 4.0]
+    # numpy's linear interpolation is the reference behavior.
+    for q in (0.0, 0.25, 0.5, 0.75, 0.9, 0.95, 1.0):
+        assert _percentile(vals, q) == pytest.approx(
+            float(np.percentile(vals, q * 100))
+        ), q
+    # The old nearest-rank rounding answered 4.0 (p100) for p95 of 4 samples.
+    assert _percentile(vals, 0.95) == pytest.approx(3.85)
+    assert _percentile([], 0.5) == 0.0
+    assert _percentile([7.0], 0.95) == 7.0
+
+
+def test_repo_roundtrip_rehydrates():
+    """A manager rebuilt over a persisted repo answers get_performance for
+    tasks only the repo remembers — including total_client_steps from the
+    extra JSON (heterogeneous step profiles)."""
+    repo = MemoryTableRepo(PERF_COLUMNS)
+    first = PerformanceManager(repo=repo)
+    for r in range(4):
+        first.record_round(RoundTiming(
+            "t-rt", r, "train", 0.5, num_clients=10, local_steps=4,
+            total_client_steps=25, extra={"note": 1.0},
+        ))
+    expect = first.get_performance("t-rt")
+
+    reborn = PerformanceManager(repo=repo)
+    got = reborn.get_performance("t-rt")
+    assert got["rounds_recorded"] == 4
+    assert got == expect
+    # total_client_steps survived the extra-JSON round trip: 0.5s / 25 steps.
+    assert got["per_client_step_latency_s"] == pytest.approx(0.5 / 25)
+    # Unknown tasks still answer empty.
+    assert reborn.get_performance("nope")["rounds_recorded"] == 0
+
+
+def test_start_trace_failure_resets_state(tmp_path, monkeypatch):
+    """A start_trace that raises must not leave the manager wedged 'in a
+    trace' — the next attempt runs."""
+    import jax
+
+    perf = PerformanceManager()
+    calls = {"stopped": 0}
+
+    def boom(logdir):
+        raise RuntimeError("logdir unwritable")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace",
+        lambda: calls.__setitem__("stopped", calls["stopped"] + 1),
+    )
+    with pytest.raises(RuntimeError):
+        perf.start_trace(str(tmp_path / "t1"))
+    assert perf._trace_dir is None
+    assert calls["stopped"] == 1  # half-open profiler session closed
+    # Recovered: a subsequent trace starts (stubbed start succeeds).
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda logdir: None)
+    assert perf.start_trace(str(tmp_path / "t2"))
+    assert perf.stop_trace() == str(tmp_path / "t2")

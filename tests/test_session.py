@@ -93,6 +93,14 @@ def test_all_services_respond(session, channel):
     perf = PerformanceMgrClient(channel)
     assert perf.get_performance("none")["rounds_recorded"] == 0
 
+    # getMetrics: the live telemetry registry over the wire, both formats.
+    ctype, body = perf.get_metrics()
+    assert ctype.startswith("text/plain")
+    assert "ols_deviceflow_queue_depth" in body  # session's deviceflow loops
+    ctype_json, body_json = perf.get_metrics("json")
+    assert ctype_json == "application/json"
+    assert "ols_deviceflow_queue_depth" in json.loads(body_json)
+
 
 def wait_and_get(phones, task_id, timeout=10):
     import time
@@ -121,6 +129,11 @@ def test_task_through_session(session, channel):
     report = perf.get_performance("sess_task")
     assert report["rounds_recorded"] >= 1
     assert report["device_rounds_per_sec"] > 0
+    # The engine run instrumented the default registry; the rendered
+    # snapshot carries its round-phase histograms and task transitions.
+    _, body = perf.get_metrics()
+    assert "ols_engine_round_phase_duration_seconds_bucket" in body
+    assert 'ols_taskmgr_state_transitions_total{status="RUNNING"}' in body
 
 
 def test_default_session_composition():
@@ -139,6 +152,23 @@ def test_default_session_composition():
             assert res["logical_simulation"]["cpu"] > 0
     finally:
         sess.stop()
+
+
+def test_session_metrics_endpoint():
+    """metrics_port wires a Prometheus scrape target into the session."""
+    import urllib.request
+
+    sess = SimulatorSession(services=("resourcemgr",), metrics_port=0)
+    sess.start()
+    try:
+        port = sess.metrics_server.port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ).read().decode()
+        assert "# TYPE" in body or body == ""  # valid exposition render
+    finally:
+        sess.stop()
+    assert sess.metrics_server is None
 
 
 def test_cluster_resource_query_rpcs(session, channel):

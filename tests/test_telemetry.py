@@ -1,0 +1,474 @@
+"""Telemetry subsystem: registry semantics, exposition formats, spans, and
+end-to-end emission from an instrumented simulation run."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.telemetry import (
+    CATALOG,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    SpanTracer,
+    instrument,
+    render_prometheus,
+    set_default_registry,
+    set_default_tracer,
+    snapshot,
+)
+
+
+# ---------------------------------------------------------------- registry
+def test_counter_and_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("ols_test_events_total", "events", labels=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels("b").inc()
+    assert c.labels(kind="a").value == 3
+    assert c.labels(kind="b").value == 1
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)  # counters only go up
+
+    g = reg.gauge("ols_test_queue_depth", "depth")
+    g.set(5)
+    g.inc()
+    g.dec(3)
+    assert g._default_child().value == 3
+
+
+def test_histogram_bucketing():
+    reg = MetricsRegistry()
+    h = reg.histogram("ols_test_latency_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    child = h._default_child()
+    assert child.count == 6
+    assert child.sum == pytest.approx(106.65)
+    # le semantics: a value equal to a bound lands in that bucket.
+    assert child.cumulative() == [2, 4, 5]  # le=0.1, le=1, le=10; +Inf == 6
+
+
+def test_histogram_rejects_empty_buckets():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.histogram("ols_test_empty_seconds", buckets=())
+
+
+def test_label_schema_enforced():
+    reg = MetricsRegistry()
+    c = reg.counter("ols_test_labeled_total", labels=("task_id", "phase"))
+    with pytest.raises(ValueError):
+        c.labels(task_id="t")  # missing phase
+    with pytest.raises(ValueError):
+        c.labels(task_id="t", phase="p", extra="x")  # unknown label
+    with pytest.raises(ValueError):
+        c.labels("a", "b", "c")  # arity
+    with pytest.raises(ValueError):
+        c.inc()  # labeled metric needs .labels()
+    # Distinct values are distinct children; same values share one.
+    c.labels("t", "select").inc()
+    c.labels("t", "train").inc(2)
+    assert c.labels(task_id="t", phase="select").value == 1
+    assert c.labels(task_id="t", phase="train").value == 2
+    assert len(c.children()) == 2
+
+
+def test_registration_idempotent_and_collision_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("ols_test_things_total", labels=("k",))
+    b = reg.counter("ols_test_things_total", labels=("k",))
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("ols_test_things_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.counter("ols_test_things_total", labels=("other",))  # labels
+
+
+def test_disabled_registry_short_circuits():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("ols_test_off_total", labels=("k",))
+    c.labels(k="x").inc(100)
+    h = reg.histogram("ols_test_off_seconds")
+    h.observe(1.0)
+    reg.enabled = True
+    assert c.labels(k="x").value == 0
+    assert h._default_child().count == 0
+
+
+# -------------------------------------------------------------- exposition
+def test_prometheus_render_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("ols_test_rounds_total", "Rounds run", labels=("status",))
+    c.labels(status="ok").inc(3)
+    g = reg.gauge("ols_test_depth", "Queue depth")
+    g.set(2)
+    h = reg.histogram("ols_test_wait_seconds", "Wait", buckets=(0.5, 2.0))
+    h.observe(0.25)
+    h.observe(1.0)
+    h.observe(9.0)
+    assert render_prometheus(reg) == (
+        "# HELP ols_test_depth Queue depth\n"
+        "# TYPE ols_test_depth gauge\n"
+        "ols_test_depth 2\n"
+        "# HELP ols_test_rounds_total Rounds run\n"
+        "# TYPE ols_test_rounds_total counter\n"
+        'ols_test_rounds_total{status="ok"} 3\n'
+        "# HELP ols_test_wait_seconds Wait\n"
+        "# TYPE ols_test_wait_seconds histogram\n"
+        'ols_test_wait_seconds_bucket{le="0.5"} 1\n'
+        'ols_test_wait_seconds_bucket{le="2"} 2\n'
+        'ols_test_wait_seconds_bucket{le="+Inf"} 3\n'
+        "ols_test_wait_seconds_sum 10.25\n"
+        "ols_test_wait_seconds_count 3\n"
+    )
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("ols_test_esc_total", labels=("msg",))
+    c.labels(msg='say "hi"\nback\\slash').inc()
+    out = render_prometheus(reg)
+    assert '{msg="say \\"hi\\"\\nback\\\\slash"}' in out
+
+
+def test_json_snapshot_roundtrips():
+    reg = MetricsRegistry()
+    reg.counter("ols_test_a_total").inc(2)
+    h = reg.histogram("ols_test_b_seconds", buckets=(1.0,))
+    h.observe(0.5)
+    snap = json.loads(json.dumps(snapshot(reg)))
+    assert snap["ols_test_a_total"]["series"][0]["value"] == 2
+    assert snap["ols_test_b_seconds"]["series"][0]["count"] == 1
+    assert snap["ols_test_b_seconds"]["series"][0]["buckets"] == {"1": 1}
+
+
+def test_http_endpoint_serves_both_formats():
+    reg = MetricsRegistry()
+    reg.counter("ols_test_http_total").inc()
+    with MetricsHTTPServer(registry=reg) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "ols_test_http_total 1" in text
+        body = json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json").read()
+        )
+        assert body["ols_test_http_total"]["series"][0]["value"] == 1
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope")
+
+
+def test_thread_safety_counters():
+    reg = MetricsRegistry()
+    c = reg.counter("ols_test_race_total", labels=("t",))
+
+    def worker(i):
+        child = c.labels(t=str(i % 4))
+        for _ in range(1000):
+            child.inc()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(ch.value for _, ch in c.children()) == 8000
+
+
+# ------------------------------------------------------------------- spans
+def test_span_nesting_and_parent_ids():
+    tracer = SpanTracer()
+    with tracer.span("round", round_idx=1) as outer:
+        with tracer.span("round.train") as mid:
+            with tracer.span("round.train.host_transfer") as inner:
+                pass
+    spans = {s.name: s for s in tracer.spans()}
+    assert spans["round"].parent_id is None
+    assert spans["round.train"].parent_id == spans["round"].span_id
+    assert (spans["round.train.host_transfer"].parent_id
+            == spans["round.train"].span_id)
+    # Finished innermost-first; durations nest.
+    assert [s.name for s in tracer.spans()] == [
+        "round.train.host_transfer", "round.train", "round"
+    ]
+    assert outer.duration_s >= mid.duration_s >= inner.duration_s
+    assert outer.attrs["round_idx"] == 1
+
+
+def test_span_sibling_parents_and_error_capture():
+    tracer = SpanTracer()
+    with tracer.span("parent"):
+        with tracer.span("a"):
+            pass
+        with pytest.raises(RuntimeError):
+            with tracer.span("b"):
+                raise RuntimeError("boom")
+    spans = {s.name: s for s in tracer.spans()}
+    assert spans["a"].parent_id == spans["parent"].span_id
+    assert spans["b"].parent_id == spans["parent"].span_id
+    assert spans["b"].attrs["error"].startswith("RuntimeError")
+
+
+def test_perfetto_export(tmp_path):
+    tracer = SpanTracer()
+    with tracer.span("round", round_idx=0):
+        pass
+    path = tracer.export(str(tmp_path / "sub" / "runner.trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    (ev,) = doc["traceEvents"]
+    assert ev["ph"] == "X" and ev["name"] == "round"
+    assert ev["dur"] >= 0 and "span_id" in ev["args"]
+
+
+def test_disabled_tracer_records_nothing():
+    tracer = SpanTracer(enabled=False)
+    with tracer.span("x"):
+        pass
+    assert tracer.spans() == []
+
+
+# ------------------------------------------------------- e2e instrumentation
+@pytest.fixture
+def fresh_telemetry():
+    """Swap in an isolated default registry + tracer for the test, restoring
+    the process defaults afterwards (instrumented modules resolve the
+    default at call time, so the swap captures everything)."""
+    reg, tracer = MetricsRegistry(), SpanTracer()
+    old_reg = set_default_registry(reg)
+    old_tracer = set_default_tracer(tracer)
+    try:
+        yield reg, tracer
+    finally:
+        set_default_registry(old_reg)
+        set_default_tracer(old_tracer)
+
+
+def _label_value(metric, **want):
+    """Sum of child values whose labels include ``want``."""
+    names = metric.label_names
+    total = 0.0
+    for key, child in metric.children():
+        labels = dict(zip(names, key))
+        if all(labels.get(k) == v for k, v in want.items()):
+            total += getattr(child, "value", getattr(child, "count", 0))
+    return total
+
+
+def test_two_round_run_emits_round_phase_metrics(fresh_telemetry, tmp_path):
+    """Tier-1 e2e: a 2-round CPU run emits the expected round-phase metric
+    names with nonzero values, plus compile/round/fedcore instruments."""
+    reg, tracer = fresh_telemetry
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+    from olearning_sim_tpu.engine import (
+        build_fedcore,
+        fedavg,
+        make_synthetic_dataset,
+    )
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.engine.runner import (
+        DataPopulation,
+        OperatorSpec,
+        SimulationRunner,
+    )
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+    from olearning_sim_tpu.performancemgr import PerformanceManager
+
+    plan = make_mesh_plan()
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (8,), "num_classes": 3}, input_shape=(8,),
+    )
+    ds = make_synthetic_dataset(
+        seed=3, num_clients=8, n_local=4, input_shape=(8,), num_classes=3
+    ).pad_for(plan, 2).place(plan)
+    runner = SimulationRunner(
+        task_id="tel-task", core=core,
+        populations=[DataPopulation(
+            name="pop", dataset=ds, device_classes=["c"],
+            class_of_client=np.zeros(ds.num_clients, int),
+            nums=[8], dynamic_nums=[0],
+        )],
+        operators=[OperatorSpec(name="train", kind="train"),
+                   OperatorSpec(name="eval", kind="eval")],
+        rounds=2, perf=PerformanceManager(),
+        checkpointer=RoundCheckpointer(str(tmp_path / "ck")),
+    )
+    runner.run()
+
+    phases = reg.get("ols_engine_round_phase_duration_seconds")
+    assert phases is not None
+    for phase in ("select", "train", "host_transfer", "eval",
+                  "accounting", "checkpoint"):
+        count = _label_value(phases, task_id="tel-task", phase=phase)
+        assert count >= 2, f"phase {phase}: {count} observations"
+        seen = [dict(zip(phases.label_names, k)) for k, _ in phases.children()]
+        assert any(lbl["phase"] == phase for lbl in seen)
+
+    assert _label_value(reg.get("ols_engine_rounds_total"),
+                        task_id="tel-task", status="ok") == 2
+    assert _label_value(reg.get("ols_engine_device_rounds_total"),
+                        task_id="tel-task") == 16  # 8 clients x 2 rounds
+    compile_g = reg.get("ols_engine_compile_duration_seconds")
+    assert _label_value(compile_g, task_id="tel-task", operator="train") > 0
+    assert _label_value(reg.get("ols_fedcore_round_steps_total"),
+                        algorithm="fedavg") == 2
+    assert _label_value(reg.get("ols_checkpoint_save_bytes_total"),
+                        task_id="") > 0  # checkpointer built w/o task_id
+    # PerformanceManager façade fed the round-duration histogram too.
+    rd = reg.get("ols_engine_round_duration_seconds")
+    assert _label_value(rd, task_id="tel-task", operator="train") >= 2
+    # Runner spans nested under the operator span.
+    names = {s.name for s in tracer.spans()}
+    assert {"round.train", "round.train.select", "round.train.train",
+            "round.train.host_transfer"} <= names
+    by_id = {s.span_id: s for s in tracer.spans()}
+    child = next(s for s in tracer.spans() if s.name == "round.train.select")
+    assert by_id[child.parent_id].name == "round.train"
+    # The rendered exposition carries all of it.
+    body = render_prometheus(reg)
+    assert 'phase="host_transfer"' in body
+    assert "ols_engine_round_phase_duration_seconds_bucket" in body
+
+
+def test_chaos_run_prometheus_render_matches_resilience_log(
+    fresh_telemetry, tmp_path
+):
+    """Acceptance: a seeded 2-round chaos run exposes, via the Prometheus
+    render, per-phase latency histograms, the deviceflow queue-depth gauge,
+    and resilience counters that match ResilienceLog.counters() exactly."""
+    reg, _tracer = fresh_telemetry
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+    from olearning_sim_tpu.deviceflow.service import DeviceFlowService
+    from olearning_sim_tpu.engine import (
+        build_fedcore,
+        fedavg,
+        make_synthetic_dataset,
+    )
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.engine.runner import (
+        DataPopulation,
+        OperatorSpec,
+        SimulationRunner,
+    )
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+    from olearning_sim_tpu.resilience import (
+        FailurePolicy,
+        FaultPlan,
+        FaultSpec,
+        ResilienceConfig,
+        ResilienceLog,
+        fast_test_policy,
+        faults,
+    )
+
+    task_id = "chaos-tel"
+    log = ResilienceLog(registry=reg)
+    plan = make_mesh_plan()
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    core = build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (8,), "num_classes": 3}, input_shape=(8,),
+    )
+    ds = make_synthetic_dataset(
+        seed=7, num_clients=8, n_local=4, input_shape=(8,), num_classes=3
+    ).pad_for(plan, 2).place(plan)
+    svc = DeviceFlowService(poll_interval=0.01)
+    svc.register_task(task_id, ["logical_simulation"])
+    svc.start()
+    strategy = json.dumps({"real_time_dispatch": {
+        "use_strategy": True, "dispatch_batch_sizes": [4],
+    }})
+    ckpt = RoundCheckpointer(str(tmp_path / "ck"), max_to_keep=2,
+                             retry_policy=fast_test_policy(3), log=log,
+                             task_id=task_id)
+    runner = SimulationRunner(
+        task_id=task_id, core=core,
+        populations=[DataPopulation(
+            name="pop", dataset=ds, device_classes=["c"],
+            class_of_client=np.zeros(ds.num_clients, int),
+            nums=[8], dynamic_nums=[0],
+        )],
+        operators=[OperatorSpec(name="train", kind="train",
+                                use_deviceflow=True,
+                                deviceflow_strategy=strategy)],
+        rounds=2, deviceflow=svc, checkpointer=ckpt,
+        resilience=ResilienceConfig(
+            failure_policy=FailurePolicy.RETRY, max_round_retries=2,
+            snapshot_rounds=True, log=log,
+        ),
+    )
+    fault_plan = FaultPlan(seed=13, specs=[
+        FaultSpec(point="checkpoint.save", times=1, error="io"),
+    ])
+    try:
+        with faults.chaos(fault_plan, log=log):
+            # A few inbound messages so the queue gauges see real traffic.
+            for i in range(3):
+                svc.publish(f"{task_id}_train_0", "logical_simulation",
+                            {"client": i})
+            history = runner.run()
+    finally:
+        svc.stop()
+    assert [h["round"] for h in history] == [0, 1]
+    assert log.count("fault_injected") == 1
+    assert log.count("retry") >= 1
+
+    body = render_prometheus(reg)
+    # Per-phase latency histograms.
+    for phase in ("select", "train", "host_transfer", "checkpoint"):
+        assert f'phase="{phase}"' in body
+    assert "ols_engine_round_phase_duration_seconds_bucket" in body
+    # Deviceflow queue-depth gauge (both rooms).
+    assert 'ols_deviceflow_queue_depth{room="inbound"}' in body
+    assert 'ols_deviceflow_queue_depth{room="shelf"}' in body
+    assert "ols_deviceflow_inbound_messages_total 3" in body
+    # Resilience counters in the render match the log exactly.
+    events = reg.get("ols_resilience_events_total")
+    rendered = {}
+    for key, child in events.children():
+        labels = dict(zip(events.label_names, key))
+        if labels["task_id"] == task_id:
+            rendered[labels["kind"]] = rendered.get(labels["kind"], 0) + \
+                int(child.value)
+    assert rendered == dict(log.counters(task_id))
+
+
+def test_retire_label_value_drops_per_task_series():
+    """Long-lived processes retire a finished task's label children so the
+    registry (and scrape body) doesn't grow forever."""
+    reg = MetricsRegistry()
+    c = reg.counter("ols_test_per_task_total", labels=("task_id", "phase"))
+    c.labels("t1", "train").inc()
+    c.labels("t1", "eval").inc()
+    c.labels("t2", "train").inc(5)
+    h = reg.histogram("ols_test_per_task_seconds", labels=("task_id",),
+                      buckets=(1.0,))
+    h.labels("t1").observe(0.5)
+    unlabeled = reg.gauge("ols_test_depth")
+    unlabeled.set(1)
+
+    assert reg.retire_label_value("task_id", "t1") == 3
+    assert len(c.children()) == 1  # t2 survives
+    assert c.labels("t2", "train").value == 5
+    assert len(h.children()) == 0
+    assert unlabeled._default_child().value == 1  # untouched
+    # Unknown label on a labeled metric raises at the metric level.
+    with pytest.raises(ValueError):
+        c.remove_children(nope="x")
+    # A retired series re-materializes at zero on next use (counter reset).
+    assert c.labels("t1", "train").value == 0
+
+
+# ---------------------------------------------------------------- catalog
+def test_catalog_metrics_instantiable():
+    """Every cataloged metric materializes cleanly in a fresh registry (no
+    schema collisions, buckets valid)."""
+    reg = MetricsRegistry()
+    for name in CATALOG:
+        instrument(name, reg)
+    assert reg.names() == sorted(CATALOG)
