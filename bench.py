@@ -50,12 +50,17 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
                input_shape=None, text=False, num_classes=10, batch=32,
                local_steps=10, block=256, timed_rounds=3, unroll=1,
                block_unroll=1, carry=None, model_overrides=None,
-               vocab_size=None, seq_len=None):
+               vocab_size=None, seq_len=None, deadline_frac=None):
     """One benchmark family: build, warm, time. Returns the record dict.
 
     ``carry``: "bf16" runs local SGD with a bfloat16 params carry (halves
     the per-step carry bytes; parity-gated by test_bf16_carry_parity).
     ``OLS_BENCH_CARRY=bf16`` applies it to every family via main().
+
+    ``deadline_frac``: run the deadline-masked round-step variant with a
+    seeded synthetic completion-time array placed so that roughly this
+    fraction of clients straggle past the deadline — measures the in-jit
+    deadline masking overhead against the same family without it.
     """
     import jax.numpy as jnp
 
@@ -83,13 +88,27 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
     personal = (core.init_personal(state, ds.num_clients)
                 if core.algorithm.personalized else None)
 
+    pace_kwargs = {}
+    if deadline_frac is not None:
+        # Seeded synthetic completion times in [0, 1) simulated seconds; the
+        # deadline sits at the (1 - deadline_frac) quantile so ~that
+        # fraction of clients is masked out in-jit each round.
+        from olearning_sim_tpu.parallel.mesh import global_put
+
+        comp = np.random.default_rng(0).random(ds.num_clients).astype(np.float32)
+        pace_kwargs = dict(
+            completion_time=global_put(comp, plan.client_sharding()),
+            deadline=float(np.quantile(comp, 1.0 - float(deadline_frac))),
+        )
+
     def step():
         nonlocal state, personal
         if personal is not None:
-            out = core.round_step(state, ds, personal=personal)
+            out = core.round_step(state, ds, personal=personal,
+                                  **pace_kwargs)
             state, metrics, personal = out
         else:
-            state, metrics = core.round_step(state, ds)
+            state, metrics = core.round_step(state, ds, **pace_kwargs)
         return metrics
 
     # Warmup (compile + 1 round); float() forces a real host sync on
@@ -122,6 +141,9 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
         "client_step_latency_us_p90": round(float(np.percentile(step_lat, 90) * 1e6), 3),
         "compile_sec": round(compile_s, 1),
         "mean_loss": loss,
+        **({"deadline_frac": float(deadline_frac),
+            "stragglers": int(metrics.stragglers)}
+           if deadline_frac is not None else {}),
     }
 
 
@@ -509,6 +531,13 @@ SUITE_FAMILIES = [
          algorithm=("fedavg", dict(local_lr=0.05)), num_clients=1000,
          n_local=20, input_shape=(32, 32, 3), block=16, unroll=10, batch=32,
          local_steps=10, timed_rounds=2),
+    # Deadline-masked variant of the mlp family: same work, 20% of clients
+    # straggling past the round deadline — the delta vs fedavg_mnist_mlp_1k
+    # is the in-jit masking + straggler-count overhead (should be noise).
+    dict(name="fedavg_mnist_mlp_1k_deadline", model="mlp2",
+         algorithm=("fedavg", dict(local_lr=0.05)), num_clients=1000,
+         n_local=20, input_shape=(28, 28, 1), block=64, unroll=10, batch=32,
+         local_steps=10, timed_rounds=2, deadline_frac=0.2),
     # resnet/distilbert/vit block+unroll follow the headline's measured
     # lesson (small client blocks + full step unroll beat big blocks for
     # conv/attention models; the round-2 sweep of these exact families was

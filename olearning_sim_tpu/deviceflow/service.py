@@ -27,6 +27,7 @@ from olearning_sim_tpu.deviceflow.registry import TaskRegistry
 from olearning_sim_tpu.deviceflow.rooms import InboundRoom, Message, ShelfRoom
 from olearning_sim_tpu.deviceflow.sorter import Sorter
 from olearning_sim_tpu.deviceflow.validate import check_notify_start_params
+from olearning_sim_tpu.utils.clocks import Deadline
 from olearning_sim_tpu.utils.logging import Logger
 from olearning_sim_tpu.utils.repo import TableRepo
 
@@ -202,8 +203,8 @@ class DeviceFlowService:
         # enqueued before this call has actually been sorted.)
         with self._lock:
             watermark = self._enqueued_count
-        deadline = time.monotonic() + flush_timeout
-        while time.monotonic() < deadline:
+        deadline = Deadline(flush_timeout)
+        while not deadline.expired():
             with self._lock:
                 if self._sorted_count >= watermark:
                     break

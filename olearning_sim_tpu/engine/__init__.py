@@ -23,11 +23,19 @@ from olearning_sim_tpu.engine.fedcore import (
     ServerState,
     build_fedcore,
 )
+from olearning_sim_tpu.engine.pacing import (
+    DeadlineConfig,
+    DeadlineController,
+    DeadlineMissError,
+)
 
 __all__ = [
     "Algorithm",
     "ClientDataset",
     "ControlState",
+    "DeadlineConfig",
+    "DeadlineController",
+    "DeadlineMissError",
     "FedCore",
     "PersonalState",
     "RoundMetrics",

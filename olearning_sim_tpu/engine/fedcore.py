@@ -75,6 +75,11 @@ class RoundMetrics(struct.PyTreeNode):
     client_loss: jnp.ndarray
     # Weight-averaged Ditto personal-branch loss (0 when not personalized).
     personal_loss: jnp.ndarray = struct.field(default_factory=lambda: jnp.float32(0.0))
+    # Participating clients whose simulated completion_time exceeded the
+    # round deadline (deadline-masked aggregation; always 0 on the
+    # deadline-off path). Distinct from drops: a straggler's update exists
+    # but arrived too late to aggregate.
+    stragglers: jnp.ndarray = struct.field(default_factory=lambda: jnp.float32(0.0))
 
 
 class PersonalState(struct.PyTreeNode):
@@ -237,6 +242,10 @@ class FedCore:
                 "option-II refresh divides by K * local_lr)"
             )
         self._round_step = self._build_round_step()
+        # Deadline-masked variant: built on first use so tasks that never
+        # set a deadline pay no extra trace/compile. The deadline-off path
+        # above stays byte-identical to a build without the subsystem.
+        self._round_step_deadline = None
         self._evaluate = self._build_evaluate()
         self._evaluate_personal = None  # built on first use
 
@@ -493,7 +502,14 @@ class FedCore:
     # program and GSPMD inserts the tensor-parallel collectives. Models
     # without specs (all-P() trees) are replicated over mp — correct but
     # redundant; the transformer families shard (parallel/tp.py).
-    def _build_round_step(self):
+    def _build_round_step(self, with_deadline: bool = False):
+        """``with_deadline=True`` builds the deadline-masked variant: two
+        extra inputs — ``completion_time`` [C] (simulated seconds, sharded
+        like the clients) and a replicated ``deadline`` scalar — turn
+        ``completion_time > deadline`` into zero aggregation weight with
+        pure ``lax`` masking (no host round-trip), and the late
+        participants are counted as ``metrics.stragglers``. The default
+        variant is byte-identical to the pre-deadline program."""
         plan = self.plan
         cfg = self.config
         alg = self.algorithm
@@ -503,7 +519,22 @@ class FedCore:
 
         def shard_body(params, opt_state, round_idx, base_key,
                        x, y, num_samples, num_steps, uid, weight, vparams,
-                       server_c, true_n):
+                       server_c, true_n, *pace):
+            stragglers = jnp.float32(0.0)
+            if with_deadline:
+                completion_time, deadline = pace
+                # A participating client whose simulated completion misses
+                # the round deadline contributes nothing. where(late, 0, w)
+                # selects the untouched weight bitwise for on-time clients,
+                # so a non-binding deadline (inf) leaves aggregation
+                # bit-for-bit unchanged.
+                late = completion_time > deadline
+                stragglers = jax.lax.psum(
+                    jnp.logical_and(weight > 0, late)
+                    .sum().astype(jnp.float32),
+                    "dp",
+                )
+                weight = jnp.where(late, jnp.zeros_like(weight), weight)
             c_local = x.shape[0]
             if c_local % cfg.block_clients != 0:
                 raise ValueError(
@@ -673,6 +704,7 @@ class FedCore:
                 clients_trained=count,
                 client_loss=client_loss,
                 personal_loss=sum_ploss / denom,
+                stragglers=stragglers,
             )
             return (new_params, new_opt_state, round_idx + 1, metrics,
                     new_vparams, new_server_c)
@@ -681,8 +713,10 @@ class FedCore:
         cl = P("dp")
         metrics_specs = RoundMetrics(
             mean_loss=rep, weight_sum=rep, clients_trained=rep, client_loss=cl,
-            personal_loss=rep,
+            personal_loss=rep, stragglers=rep,
         )
+        # completion_time is sharded like the clients; deadline replicated.
+        pace_specs = (cl, rep) if with_deadline else ()
 
         def make_shard_fn(vp_tree, sc_tree=None):
             vp_spec = jax.tree.map(lambda _: cl, vp_tree)
@@ -694,7 +728,7 @@ class FedCore:
                 shard_body,
                 mesh=mesh,
                 in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl,
-                          vp_spec, sc_spec, rep),
+                          vp_spec, sc_spec, rep) + pace_specs,
                 out_specs=(rep, rep, rep, metrics_specs, vp_spec, sc_spec),
                 axis_names=frozenset({"dp"}),
             )
@@ -702,7 +736,8 @@ class FedCore:
         if controlled:
             @functools.partial(jax.jit, donate_argnums=(0, 1))
             def round_step(state: ServerState, control: ControlState,
-                           x, y, num_samples, num_steps, uid, weight, true_n):
+                           x, y, num_samples, num_steps, uid, weight, true_n,
+                           *pace):
                 (new_params, new_opt_state, new_round, metrics, new_ci,
                  new_sc) = make_shard_fn(
                     control.client_controls, control.server_control
@@ -710,7 +745,7 @@ class FedCore:
                     state.params, state.opt_state, state.round_idx,
                     state.base_key, x, y, num_samples, num_steps, uid,
                     weight, control.client_controls, control.server_control,
-                    true_n,
+                    true_n, *pace,
                 )
                 return (
                     ServerState(
@@ -725,12 +760,13 @@ class FedCore:
         elif personalized:
             @functools.partial(jax.jit, donate_argnums=(0, 1))
             def round_step(state: ServerState, personal: PersonalState,
-                           x, y, num_samples, num_steps, uid, weight):
+                           x, y, num_samples, num_steps, uid, weight, *pace):
                 new_params, new_opt_state, new_round, metrics, new_vp, _ = (
                     make_shard_fn(personal.params)(
                         state.params, state.opt_state, state.round_idx,
                         state.base_key, x, y, num_samples, num_steps, uid,
                         weight, personal.params, None, jnp.float32(0.0),
+                        *pace,
                     )
                 )
                 return (
@@ -747,11 +783,12 @@ class FedCore:
             shard_fn = make_shard_fn(None)
 
             @functools.partial(jax.jit, donate_argnums=(0,))
-            def round_step(state: ServerState, x, y, num_samples, num_steps, uid, weight):
+            def round_step(state: ServerState, x, y, num_samples, num_steps,
+                           uid, weight, *pace):
                 new_params, new_opt_state, new_round, metrics, _, _ = shard_fn(
                     state.params, state.opt_state, state.round_idx, state.base_key,
                     x, y, num_samples, num_steps, uid, weight, None, None,
-                    jnp.float32(0.0),
+                    jnp.float32(0.0), *pace,
                 )
                 return (
                     ServerState(
@@ -824,6 +861,8 @@ class FedCore:
         num_steps: Optional[jax.Array] = None,
         personal: Optional[PersonalState] = None,
         control: Optional[ControlState] = None,
+        completion_time: Optional[jax.Array] = None,
+        deadline: Optional[float] = None,
     ):
         """Advance one FL round over the (placed, padded) population.
 
@@ -835,6 +874,14 @@ class FedCore:
         ``(state, metrics, personal)``. ``control`` — SCAFFOLD control
         variates (required iff the algorithm uses them); the return is then
         ``(state, metrics, control)``.
+
+        ``deadline`` + ``completion_time`` — deadline-masked aggregation:
+        clients whose simulated ``completion_time`` [C] exceeds the
+        ``deadline`` scalar get zero aggregation weight inside the compiled
+        program and are counted in ``metrics.stragglers``. Both are data
+        (not compile-time constants), so per-round deadlines never
+        recompile. With ``deadline=None`` the original program runs with
+        the original inputs — bitwise identical to the deadline-free build.
         """
         weight = ds.weight if participate is None else ds.weight * participate
         if num_steps is None:
@@ -842,6 +889,22 @@ class FedCore:
                 np.full((ds.num_clients,), self.config.max_local_steps, np.int32),
                 self.plan.client_sharding(),
             )
+        fn = self._round_step
+        pace = ()
+        if deadline is not None:
+            if completion_time is None:
+                raise ValueError(
+                    "deadline given without completion_time; compute one "
+                    "with olearning_sim_tpu.engine.pacing.completion_times"
+                )
+            if self._round_step_deadline is None:
+                self._round_step_deadline = self._build_round_step(
+                    with_deadline=True
+                )
+            fn = self._round_step_deadline
+            pace = (completion_time, jnp.float32(deadline))
+        elif completion_time is not None:
+            raise ValueError("completion_time given without a deadline")
         if self.algorithm.control_variates:
             if control is None:
                 raise ValueError(
@@ -850,8 +913,8 @@ class FedCore:
                     f"ds.num_clients)"
                 )
             return self._launch(
-                state, control, ds.x, ds.y, ds.num_samples, num_steps,
-                ds.client_uid, weight, jnp.float32(ds.population),
+                fn, state, control, ds.x, ds.y, ds.num_samples, num_steps,
+                ds.client_uid, weight, jnp.float32(ds.population), *pace,
             )
         if control is not None:
             raise ValueError(
@@ -865,8 +928,8 @@ class FedCore:
                     f"personal=core.init_personal(state, ds.num_clients)"
                 )
             return self._launch(
-                state, personal, ds.x, ds.y, ds.num_samples, num_steps,
-                ds.client_uid, weight,
+                fn, state, personal, ds.x, ds.y, ds.num_samples, num_steps,
+                ds.client_uid, weight, *pace,
             )
         if personal is not None:
             raise ValueError(
@@ -874,11 +937,12 @@ class FedCore:
                 f"personal state was supplied"
             )
         return self._launch(
-            state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid, weight
+            fn, state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid,
+            weight, *pace,
         )
 
-    def _launch(self, *args):
-        """Launch the compiled round step, counting launches and host-side
+    def _launch(self, fn, *args):
+        """Launch a compiled round step, counting launches and host-side
         dispatch latency (async — device completion is the runner's
         ``host_transfer`` phase). The first launch pays synchronous
         trace+compile (seconds to minutes) and is excluded from the
@@ -889,7 +953,7 @@ class FedCore:
         from olearning_sim_tpu.telemetry import instrument
 
         t0 = time.perf_counter()
-        out = self._round_step(*args)
+        out = fn(*args)
         name = self.algorithm.name
         instrument("ols_fedcore_round_steps_total").labels(
             algorithm=name

@@ -34,6 +34,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import json
+import math
 import threading
 import time
 import zlib
@@ -46,8 +47,16 @@ from olearning_sim_tpu.deviceflow.service import DeviceFlowService
 from olearning_sim_tpu.deviceflow.trace_compiler import ClientTrace, compile_trace
 from olearning_sim_tpu.engine.client_data import ClientDataset
 from olearning_sim_tpu.engine.fedcore import FedCore
+from olearning_sim_tpu.engine import pacing
+from olearning_sim_tpu.engine.pacing import (
+    DeadlineConfig,
+    DeadlineController,
+    DeadlineMissError,
+    RoundPacing,
+)
 from olearning_sim_tpu.parallel.mesh import global_put
 from olearning_sim_tpu.resilience import (
+    DEADLINE_MISS,
     ROLLBACK,
     SKIP_ROUND,
     FailurePolicy,
@@ -130,6 +139,7 @@ class SimulationRunner:
         resilience: Optional[ResilienceConfig] = None,
         registry: Optional[Any] = None,
         tracer: Optional[Any] = None,
+        deadline: Optional[DeadlineConfig] = None,
     ):
         """``model_io`` — a :class:`ModelUpdateExporter` realizing the
         reference's model-update-style convention (round r's global model
@@ -140,7 +150,14 @@ class SimulationRunner:
         resilient round execution (None keeps the pre-resilience fail-fast
         behavior bit-for-bit). ``registry`` / ``tracer`` — telemetry sinks
         (:mod:`olearning_sim_tpu.telemetry`); None resolves the process
-        defaults at use time."""
+        defaults at use time. ``deadline`` — opt-in deadline-aware rounds
+        (:class:`~olearning_sim_tpu.engine.pacing.DeadlineConfig`):
+        completion-time model, over-selection, deadline-masked aggregation
+        with distinct straggler accounting, quorum enforcement routed
+        through the failure policy as ``deadline_miss`` events, and
+        adaptive pacing whose controller state rides the per-round history
+        records (and therefore checkpoint/rollback). None keeps rounds
+        deadline-free, bitwise identical to the pre-deadline engine."""
         self.task_id = task_id
         self.core = core
         self.populations = populations
@@ -198,6 +215,15 @@ class SimulationRunner:
         # Routing key of the deviceflow flow currently open (None between
         # operators); closed best-effort when a round fails mid-operator.
         self._live_routing_key: Optional[str] = None
+        # Deadline-aware rounds: one controller per task (shared across
+        # populations/train operators — its EMA tracks the task's overall
+        # completion-time distribution). None = deadline-free rounds.
+        self.deadline = (deadline if deadline is not None and deadline.enabled
+                         else None)
+        self._pacer: Optional[DeadlineController] = (
+            DeadlineController(self.deadline)
+            if self.deadline is not None else None
+        )
 
         if not self.task_repo.has_task(task_id):
             self.task_repo.add_task(task_id)
@@ -338,6 +364,66 @@ class SimulationRunner:
         ).observe(time.perf_counter() - t0)
 
     # -------------------------------------------------------------- operators
+    def _plan_pacing(self, p: DataPopulation, round_idx: int,
+                     operator: OperatorSpec, trace: ClientTrace,
+                     eligible: np.ndarray) -> RoundPacing:
+        """Host-side deadline plan for one (population, round): over-select
+        the cohort, derive each client's simulated completion time (network
+        arrival + device-class compute), and close the round at the earlier
+        of (controller deadline, K-th arrival). Deterministic for a given
+        (config, trace_seed, operator, population, round) — rollback
+        replays reproduce the exact straggler set, while distinct
+        (operator, population) pairs draw decorrelated streams."""
+        cfg = self.deadline
+        real = p.dataset.num_real_clients
+        stream = zlib.crc32(f"{operator.name}\x00{p.name}".encode())
+        selected = pacing.select_cohort(
+            eligible, cfg, self.trace_seed, round_idx, stream=stream
+        )
+        if p.num_steps is not None:
+            steps = np.minimum(
+                np.asarray(p.num_steps[:real], np.int32),
+                self.core.config.max_local_steps,
+            )
+        else:
+            steps = np.full(real, self.core.config.max_local_steps, np.int32)
+        completion = pacing.completion_times(
+            trace.arrival_time[:real], steps, p.class_of_client[:real],
+            p.device_classes, cfg, self.trace_seed, round_idx,
+            stream=stream,
+        )
+        # ``runner.straggler_spike`` injection point: a simulated fleet-wide
+        # (or targeted) slowdown — congestion, thermal throttling — that
+        # multiplies completion times for this round. Payload:
+        # ``{"factor": 5.0, "clients": [...]?}``; scope to one population
+        # with the spec's ``match`` filter (the context is the population
+        # name) — a payload-side filter would consume the firing for the
+        # wrong population.
+        spec = faults.fire("runner.straggler_spike", context=p.name,
+                           round_idx=round_idx, task_id=self.task_id)
+        if spec is not None:
+            payload = spec.payload or {}
+            factor = np.float32(payload.get("factor", 10.0))
+            clients = payload.get("clients")
+            if clients is None:
+                completion = completion * factor
+            else:
+                idx = [int(c) for c in clients if int(c) < real]
+                completion[idx] = completion[idx] * factor
+        completion = np.where(selected, completion, np.inf).astype(np.float32)
+        eff = pacing.effective_deadline(
+            completion, selected, cfg, self._pacer.current_deadline()
+        )
+        n_selected = int(selected.sum())
+        n_on_time = int((selected & (completion <= eff)).sum())
+        quorum_base = (cfg.target_cohort if cfg.target_cohort is not None
+                       else n_selected)
+        return RoundPacing(
+            selected=selected, completion=completion, deadline_s=float(eff),
+            n_selected=n_selected, n_on_time=n_on_time,
+            quorum_required=int(math.ceil(cfg.quorum_fraction * quorum_base)),
+        )
+
     def _run_train(self, p: DataPopulation, round_idx: int,
                    operator: OperatorSpec) -> Dict[str, Any]:
         from olearning_sim_tpu.telemetry import instrument
@@ -366,6 +452,38 @@ class SimulationRunner:
                 mask[:real] = mask[:real] * self._quarantine.active_mask(
                     p.name, real
                 ).astype(mask.dtype)
+            pace: Optional[RoundPacing] = None
+            completion_dev = None
+            if self.deadline is not None:
+                pace = self._plan_pacing(p, round_idx, operator, trace,
+                                         mask[:real] > 0)
+                if not pace.quorum_met:
+                    # Quorum enforced BEFORE any device transfer or round
+                    # step launch (state untouched): a starved cohort must
+                    # degrade through the failure policy, not silently
+                    # aggregate.
+                    self._rlog.record(
+                        DEADLINE_MISS, point="runner.deadline",
+                        task_id=self.task_id, round_idx=round_idx,
+                        population=p.name, on_time=pace.n_on_time,
+                        required=pace.quorum_required,
+                        selected=pace.n_selected, deadline_s=pace.deadline_s,
+                    )
+                    raise DeadlineMissError(
+                        f"round {round_idx} population {p.name}: "
+                        f"{pace.n_on_time} on-time of {pace.n_selected} "
+                        f"selected is below the quorum of "
+                        f"{pace.quorum_required} "
+                        f"(deadline {pace.deadline_s:.3f}s)"
+                    )
+                # Over-selection: non-selected eligible clients sit this
+                # round out (indistinguishable from churn to the program).
+                mask[:real] = np.where(pace.selected, mask[:real], 0)
+                comp_full = np.full(p.dataset.num_clients, np.inf, np.float32)
+                comp_full[:real] = pace.completion
+                completion_dev = global_put(
+                    comp_full, self.core.plan.client_sharding()
+                )
             participate = global_put(mask, self.core.plan.client_sharding())
             num_steps = None
             if p.num_steps is not None:
@@ -376,6 +494,10 @@ class SimulationRunner:
         t_step0 = time.perf_counter()
         with self._phase(operator.name, "train", round_idx):
             state = self.states[p.name]
+            pace_kwargs = {}
+            if pace is not None:
+                pace_kwargs = dict(completion_time=completion_dev,
+                                   deadline=pace.deadline_s)
             if self.core.algorithm.personalized:
                 personal = self.personal_states.get(p.name)
                 if personal is None:
@@ -384,7 +506,7 @@ class SimulationRunner:
                     )
                 state, metrics, personal = self.core.round_step(
                     state, p.dataset, participate=participate,
-                    personal=personal, num_steps=num_steps,
+                    personal=personal, num_steps=num_steps, **pace_kwargs,
                 )
                 self.personal_states[p.name] = personal
             elif self.core.algorithm.control_variates:
@@ -395,13 +517,13 @@ class SimulationRunner:
                     )
                 state, metrics, control = self.core.round_step(
                     state, p.dataset, participate=participate,
-                    control=control, num_steps=num_steps,
+                    control=control, num_steps=num_steps, **pace_kwargs,
                 )
                 self.control_states[p.name] = control
             else:
                 state, metrics = self.core.round_step(
                     state, p.dataset, participate=participate,
-                    num_steps=num_steps
+                    num_steps=num_steps, **pace_kwargs,
                 )
             self.states[p.name] = state
         with self._phase(operator.name, "host_transfer", round_idx):
@@ -439,6 +561,36 @@ class SimulationRunner:
             "sim_duration_s": trace.round_duration(),
             "ok_mask": ok,
         }
+        if pace is not None:
+            # Stragglers of record come from the compiled program's own
+            # deadline mask (metrics.stragglers) — the aggregation's truth,
+            # reported distinctly from drops.
+            stragglers = int(metrics.stragglers)
+            rec.update(
+                selected=pace.n_selected,
+                on_time=pace.n_on_time,
+                stragglers=stragglers,
+                deadline_s=(pace.deadline_s
+                            if np.isfinite(pace.deadline_s) else None),
+                round_close_s=pace.round_close_s(),
+            )
+            instrument("ols_engine_stragglers_total", self.registry).labels(
+                task_id=self.task_id
+            ).inc(stragglers)
+            finite = pace.completion[np.isfinite(pace.completion)]
+            instrument(
+                "ols_engine_completion_time_seconds", self.registry
+            ).labels(task_id=self.task_id).observe_many(finite)
+            if np.isfinite(pace.deadline_s):
+                instrument(
+                    "ols_engine_round_deadline_seconds", self.registry
+                ).labels(task_id=self.task_id).observe(pace.deadline_s)
+            # Adaptive pacing feedback: the controller observes the selected
+            # cohort's completion times (deadline-independent), so the next
+            # round's deadline tracks the population's real latency. Updated
+            # only on rounds that launched — a rolled-back round's
+            # observation is discarded with the rest of its state.
+            self._pacer.observe(finite)
         if self.core.algorithm.personalized:
             rec["personal_loss"] = float(metrics.personal_loss)
         return rec
@@ -594,6 +746,7 @@ class SimulationRunner:
         elif self.core.algorithm.control_variates:
             self.control_states = client_states
         self.history = history
+        self._repace()
         self.logger.info(
             task_id=self.task_id, system_name="engine", module_name="runner",
             message=f"resumed from checkpoint: round {last_round} complete",
@@ -701,8 +854,17 @@ class SimulationRunner:
             k: self._copy_tree(v) for k, v in snap["control"].items()
         }
         self.history = list(snap["history"])
+        self._repace()
         if self._quarantine is not None and snap["quarantine"] is not None:
             self._quarantine.restore(snap["quarantine"])
+
+    def _repace(self) -> None:
+        """Rehydrate the adaptive deadline controller from the history just
+        restored (rollback or checkpoint resume): the newest record carrying
+        pacing state holds the controller as of that round's completion, so
+        replayed rounds see exactly the deadlines they originally saw."""
+        if self._pacer is not None:
+            self._pacer.load_from_history(self.history)
 
     def _maybe_poison(self, round_idx: int) -> None:
         """``runner.poison_clients`` injection point: permanently corrupt the
@@ -966,6 +1128,16 @@ class SimulationRunner:
                     else:
                         raise ValueError(f"unknown operator kind {operator.kind!r}")
                     op_record[p.name] = r
+                if operator.kind == "train" and hasattr(timer, "note"):
+                    # Straggler/drop counts ride the RoundTiming extra so
+                    # get_performance() reports them distinctly (satellite:
+                    # stragglers are not drops).
+                    timer.note(
+                        stragglers=sum(rec.get("stragglers", 0)
+                                       for rec in op_record.values()),
+                        dropped=sum(rec.get("dropped", 0)
+                                    for rec in op_record.values()),
+                    )
             if operator.kind == "train" and nc:
                 instrument(
                     "ols_engine_device_rounds_total", self.registry
@@ -977,6 +1149,11 @@ class SimulationRunner:
             round_record[operator.name] = op_record
             self._round_outputs[operator.name] = op_record
 
+        if self._pacer is not None and self.deadline.adaptive:
+            # Controller state after this round's observations. History
+            # records ride both the in-memory snapshot and the checkpoint
+            # meta, so rollback/resume repaces deterministically (_repace).
+            round_record["pacing"] = self._pacer.state_dict()
         self.history.append(round_record)
         # A preemption here ("runner.pre_checkpoint") dies with the round's
         # work done but not yet durable — the classic lost-round scenario the

@@ -19,7 +19,9 @@ Arbitrary user code still works through the ``custom`` operator kind
       "algorithm": {"name": "fedavg", "local_lr": 0.05, ...},
       "fedcore":   {"batch_size": 32, "max_local_steps": 10, "block_clients": 64},
       "data":      {"synthetic": {"seed": 0, "n_local": 20, "num_classes": 10,
-                    "dirichlet_alpha": null, "class_sep": 2.0}, "eval_n": 1024}
+                    "dirichlet_alpha": null, "class_sep": 2.0}, "eval_n": 1024},
+      "resilience": { ...ResilienceConfig.from_dict... },    # docs/resilience.md
+      "deadline":   { ...DeadlineConfig.from_dict... }       # deadline-aware rounds
     }
 """
 
@@ -362,6 +364,17 @@ def build_runner_from_taskconfig(
 
         resilience = ResilienceConfig.from_dict(params["resilience"])
 
+    # Deadline-aware rounds ride the same blob (docs/resilience.md):
+    #   {"deadline": {"deadline_s": 30.0, "over_selection": 0.3,
+    #                 "target_cohort": 80, "quorum_fraction": 0.5,
+    #                 "speed_profiles": {"high": 0.05, "low": 0.4},
+    #                 "adaptive": true}}
+    deadline = None
+    if params.get("deadline"):
+        from olearning_sim_tpu.engine.pacing import DeadlineConfig
+
+        deadline = DeadlineConfig.from_dict(params["deadline"])
+
     return SimulationRunner(
         task_id=tc.taskID.taskID,
         core=core,
@@ -377,4 +390,5 @@ def build_runner_from_taskconfig(
         model_io=model_io,
         warm_start_path=warm_start_path,
         resilience=resilience,
+        deadline=deadline,
     )

@@ -146,6 +146,14 @@ class PerformanceManager:
             self._mgr = mgr
             self._args = (task_id, round_idx, operator, num_clients,
                           local_steps, total_client_steps)
+            # Values the caller learns mid-round (straggler/drop counts)
+            # land in the recorded RoundTiming's extra via note().
+            self.extra: Dict[str, float] = {}
+
+        def note(self, **extra: float) -> None:
+            """Attach extra key/values to the timing recorded at exit
+            (called inside the ``with`` block)."""
+            self.extra.update(extra)
 
         def __enter__(self):
             self._t0 = time.perf_counter()
@@ -158,6 +166,7 @@ class PerformanceManager:
                     task_id=task_id, round_idx=round_idx, operator=operator,
                     duration_s=time.perf_counter() - self._t0,
                     num_clients=nc, local_steps=ls, total_client_steps=tcs,
+                    extra=dict(self.extra),
                 ))
             return False
 
@@ -250,6 +259,17 @@ class PerformanceManager:
         total_time = sum(durations)
         total_clients = sum(t.num_clients for t in rows)
         distinct_rounds = len({t.round_idx for t in rows})
+
+        def _extra_total(key: str) -> int:
+            # Dedup by (round, operator), last row wins: a rolled-back round
+            # that replays records a second timing row for the same round,
+            # and summing both would double-count its stragglers/drops.
+            latest: Dict[Any, RoundTiming] = {}
+            for t in rows:
+                latest[(t.round_idx, t.operator)] = t
+            return sum(int(t.extra.get(key, 0) or 0)
+                       for t in latest.values())
+
         return {
             "task_id": task_id,
             "rounds_recorded": distinct_rounds,
@@ -264,6 +284,10 @@ class PerformanceManager:
                 "max": durations[-1],
             },
             "per_client_step_latency_s": _mean_step_latency(rows),
+            # Deadline-aware rounds: clients that missed the round deadline
+            # (stragglers) reported distinctly from trace-level drops.
+            "stragglers_total": _extra_total("stragglers"),
+            "dropped_total": _extra_total("dropped"),
             "resilience": resilience,
         }
 

@@ -17,6 +17,7 @@ TPU engine (docs/resilience.md):
 
 from olearning_sim_tpu.resilience.events import (
     CHECKPOINT_FALLBACK,
+    DEADLINE_MISS,
     FAULT_INJECTED,
     OUTBOUND_DEGRADED,
     QUARANTINE,
@@ -52,6 +53,7 @@ from olearning_sim_tpu.resilience.retry import (
 
 __all__ = [
     "CHECKPOINT_FALLBACK",
+    "DEADLINE_MISS",
     "FAULT_INJECTED",
     "OUTBOUND_DEGRADED",
     "QUARANTINE",

@@ -33,6 +33,9 @@ READMIT = "readmit"
 SKIP_ROUND = "skip_round"
 OUTBOUND_DEGRADED = "outbound_degraded"
 CHECKPOINT_FALLBACK = "checkpoint_fallback"
+# A round closed below its quorum of on-time completions (deadline-aware
+# rounds, engine/pacing.py) and was routed through the failure policy.
+DEADLINE_MISS = "deadline_miss"
 
 
 @dataclasses.dataclass

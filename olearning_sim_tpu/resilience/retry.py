@@ -30,6 +30,7 @@ from olearning_sim_tpu.resilience.events import (
     global_log,
 )
 from olearning_sim_tpu.resilience.faults import HostPreemption
+from olearning_sim_tpu.utils.clocks import Deadline
 
 # Exceptions a RetryPolicy refuses to absorb regardless of ``retry_on``.
 NON_RETRYABLE = (HostPreemption, NotImplementedError, KeyboardInterrupt,
@@ -86,7 +87,9 @@ class RetryPolicy:
         result is returned as-is; a raised retryable exception is re-raised.
         """
         log = log if log is not None else global_log()
-        start = time.monotonic()
+        # Monotonic countdown via the shared clock helper: a wall-clock step
+        # must never expire (or extend) the retry deadline.
+        deadline = Deadline(self.deadline)
         delays = iter(self.delays())
         attempt = 0
         while True:
@@ -96,18 +99,18 @@ class RetryPolicy:
             except BaseException as e:  # noqa: BLE001 — filtered below
                 if not self._retryable(e):
                     raise
-                if not self._budget_left(attempt, start, delays, point,
+                if not self._budget_left(attempt, deadline, delays, point,
                                          task_id, log, error=e):
                     raise
                 continue
             if retry_if is None or not retry_if(result):
                 return result
-            if not self._budget_left(attempt, start, delays, point, task_id,
-                                     log, error=None):
+            if not self._budget_left(attempt, deadline, delays, point,
+                                     task_id, log, error=None):
                 return result
 
-    def _budget_left(self, attempt: int, start: float, delays, point: str,
-                     task_id: str, log: ResilienceLog,
+    def _budget_left(self, attempt: int, deadline: Deadline, delays,
+                     point: str, task_id: str, log: ResilienceLog,
                      error: Optional[BaseException]) -> bool:
         """Record the retry (or exhaustion) and burn the backoff delay.
         Returns False when attempts or deadline are spent."""
@@ -124,9 +127,7 @@ class RetryPolicy:
                 log.record(RETRY_EXHAUSTED, point=point, task_id=task_id,
                            **detail)
             return False
-        if self.deadline is not None and (
-            time.monotonic() - start + delay > self.deadline
-        ):
+        if delay > deadline.remaining():
             log.record(RETRY_EXHAUSTED, point=point, task_id=task_id,
                        reason="deadline", **detail)
             return False

@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from olearning_sim_tpu.utils.clocks import Deadline
 from olearning_sim_tpu.utils.logging import Logger
 
 # A strategy factory returns an object with:
@@ -58,9 +59,10 @@ class PollingRoundBarrier:
         self.round_provider = round_provider
 
     def _poll(self, ctx, predicate):
-        start = time.time()
+        # Monotonic countdown: a wall-clock step (NTP correction) must
+        # neither expire the barrier early nor stall it past its timeout.
+        deadline = Deadline(float(ctx.get("total_timeout", 0)))
         wait_interval = max(float(ctx.get("wait_interval", 0)), 1e-3)
-        total_timeout = float(ctx.get("total_timeout", 0))
         stop_event = ctx.get("stop_event")
         while True:
             if stop_event is not None and stop_event.is_set():
@@ -68,7 +70,7 @@ class PollingRoundBarrier:
             current = self.round_provider()
             if current is not None and predicate(current):
                 return True, current
-            if time.time() - start >= total_timeout:
+            if deadline.expired():
                 return False, None
             time.sleep(wait_interval)
 
@@ -102,9 +104,9 @@ class FlagFileBarrier:
         return True, None
 
     def stop(self, ctx, previous_round):
-        start = time.time()
+        # Monotonic countdown (same rationale as PollingRoundBarrier._poll).
+        deadline = Deadline(float(ctx.get("total_timeout", 0)))
         wait_interval = max(float(ctx.get("wait_interval", 0)), 1e-3)
-        total_timeout = float(ctx.get("total_timeout", 0))
         stop_event = ctx.get("stop_event")
         while True:
             if stop_event is not None and stop_event.is_set():
@@ -116,7 +118,7 @@ class FlagFileBarrier:
                     except OSError:
                         pass
                 return True, None
-            if time.time() - start >= total_timeout:
+            if deadline.expired():
                 return False, None
             time.sleep(wait_interval)
 

@@ -164,9 +164,31 @@ def validate_correctness(request) -> Tuple[bool, str]:
                              f"operator {op.name} device operatorEntryFile should be .apk")
                 if info.operatorParams:
                     try:
-                        json.loads(info.operatorParams)
+                        op_params = json.loads(info.operatorParams)
                     except (ValueError, TypeError):
                         raise Check(f"operator {op.name} {which} operatorParams should be a json string")
+                    if which == "logical" and isinstance(op_params, dict) \
+                            and op_params.get("deadline"):
+                        # Deadline-aware round knobs (engine/pacing.py):
+                        # reject malformed quorum/over-selection fields at
+                        # submit time, not mid-round.
+                        from olearning_sim_tpu.engine.pacing import (
+                            DeadlineConfig,
+                        )
+
+                        try:
+                            DeadlineConfig.from_dict(op_params["deadline"])
+                        except Check:
+                            raise
+                        # Wrong-shaped JSON (a string where a dict belongs,
+                        # a list for speed_profiles) raises AttributeError/
+                        # KeyError from from_dict — still a validation
+                        # failure, not a server error.
+                        except Exception as e:  # noqa: BLE001
+                            raise Check(
+                                f"operator {op.name} deadline params "
+                                f"invalid: {type(e).__name__}: {e}"
+                            )
 
         units = list(request.logicalSimulation.computationUnit.devicesUnit)
         _req(len(units) == len(set(units)), "computationUnit.devicesUnit has repeats")

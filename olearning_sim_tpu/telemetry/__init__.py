@@ -62,6 +62,10 @@ _IO_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                10.0, 30.0, 60.0)
 _DISPATCH_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
                      1.0, 5.0)
+# Simulated device time (completion/deadline): phone rounds span sub-second
+# high-tier devices to many-minute stragglers.
+_SIM_TIME_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                     120.0, 300.0, 600.0, 1800.0)
 
 # name -> (kind, help, label names[, buckets]). THE metric catalog of
 # record: docs/observability.md renders this table and the naming lint
@@ -95,6 +99,24 @@ CATALOG = {
         COUNTER,
         "Virtual device-rounds advanced (clients x train rounds)",
         ("task_id",),
+    ),
+    "ols_engine_stragglers_total": (
+        COUNTER,
+        "Selected clients whose simulated completion missed the round "
+        "deadline (deadline-masked aggregation; distinct from drops)",
+        ("task_id",),
+    ),
+    "ols_engine_completion_time_seconds": (
+        HISTOGRAM,
+        "Simulated per-client completion times (network arrival + "
+        "device-class compute) of each round's selected cohort",
+        ("task_id",), _SIM_TIME_BUCKETS,
+    ),
+    "ols_engine_round_deadline_seconds": (
+        HISTOGRAM,
+        "Effective round deadline (static, adaptive-controller, or K-th "
+        "arrival close) per train round",
+        ("task_id",), _SIM_TIME_BUCKETS,
     ),
     # ------------------------------------------------------------ fedcore
     "ols_fedcore_round_steps_total": (

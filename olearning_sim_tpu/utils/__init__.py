@@ -1,3 +1,4 @@
+from olearning_sim_tpu.utils.clocks import Deadline, monotonic
 from olearning_sim_tpu.utils.repo import (
     MemoryTableRepo,
     MySqlTableRepo,
@@ -6,5 +7,5 @@ from olearning_sim_tpu.utils.repo import (
 )
 from olearning_sim_tpu.utils.logging import Logger
 
-__all__ = ["Logger", "MemoryTableRepo", "MySqlTableRepo", "SqliteTableRepo",
-           "TableRepo"]
+__all__ = ["Deadline", "Logger", "MemoryTableRepo", "MySqlTableRepo",
+           "SqliteTableRepo", "TableRepo", "monotonic"]

@@ -67,6 +67,13 @@ def shrink(tj, clients_per_class=4, rounds=1):
             params["data"]["compute_profiles"] = {
                 c: min(int(v), 2) for c, v in params["data"]["compute_profiles"].items()
             }
+        if "deadline" in params and params["deadline"].get("target_cohort"):
+            # Scale the over-selection target down with the population so
+            # the quorum stays satisfiable at CI size.
+            params["deadline"]["target_cohort"] = min(
+                int(params["deadline"]["target_cohort"]),
+                clients_per_class * k,
+            )
         # Scale trace totals down to the shrunken population.
         ctl = op["operation_behavior_controller"]
         if ctl["use_gradient_house"] and ctl["strategy_gradient_house"]:

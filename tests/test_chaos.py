@@ -55,7 +55,8 @@ def core(plan):
 
 
 def make_runner(core, plan, log, ckpt=None, model_io=None, rounds=ROUNDS,
-                failure_policy=FailurePolicy.RETRY, task_id="chaos-task"):
+                failure_policy=FailurePolicy.RETRY, task_id="chaos-task",
+                deadline=None):
     ds = make_synthetic_dataset(
         7, NUM_CLIENTS, 6, (8,), 3, class_sep=3.0
     ).pad_for(plan, 2).place(plan)
@@ -72,6 +73,7 @@ def make_runner(core, plan, log, ckpt=None, model_io=None, rounds=ROUNDS,
         task_id=task_id, core=core, populations=[pop],
         operators=[OperatorSpec(name="train")], rounds=rounds,
         checkpointer=ckpt, model_io=model_io, resilience=res,
+        deadline=deadline,
     )
 
 
@@ -170,6 +172,51 @@ def test_chaos_run_matches_fault_free_survivors(core, plan, tmp_path):
     faulted, clean = _params(runner), _params(base)
     assert len(faulted) == len(clean)
     for x, y in zip(faulted, clean):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_preemption_replays_deadline_rounds_bitwise(core, plan, tmp_path):
+    """Chaos x deadlines (satellite): a HostPreemption rollback across
+    deadline-masked rounds must replay the SAME straggler set (completion
+    times and pacing are keyed by round + seeded jitter, controller state
+    rides the checkpointed history) and aggregate bitwise-identically to an
+    unfaulted run."""
+    from olearning_sim_tpu.engine.pacing import DeadlineConfig
+
+    # One device class; seeded jitter in [1, 2] spreads completion across
+    # [1.0, 2.0]s, so the 1.5s initial deadline carves a per-round,
+    # seed-determined straggler set. The adaptive controller then repaces,
+    # which is exactly the state rollback must restore.
+    dl = DeadlineConfig(deadline_s=1.5, default_step_s=0.5, jitter=1.0,
+                        adaptive=True, target_completion_fraction=0.75,
+                        ema_beta=0.5)
+    log = ResilienceLog()
+    ckpt = RoundCheckpointer(str(tmp_path / "ck-dl"), max_to_keep=4,
+                             retry_policy=fast_test_policy(3), log=log)
+    runner = make_runner(core, plan, log, ckpt=ckpt, deadline=dl)
+    fault_plan = FaultPlan(seed=13, specs=[
+        # Host dies entering round 3: recovery replays from the last
+        # checkpoint; rounds 3-4 must reproduce their original pacing.
+        FaultSpec(point="runner.round_begin", rounds=[3], error="preempt"),
+    ])
+    with faults.chaos(fault_plan, log=log):
+        history = runner.run()
+    assert [h["round"] for h in history] == list(range(ROUNDS))
+    assert log.count(ROLLBACK) == 1
+
+    base = make_runner(core, plan, ResilienceLog(), deadline=dl)
+    base_history = base.run()
+
+    some_stragglers = False
+    for fh, bh in zip(history, base_history):
+        f, b = fh["train"]["data_0"], bh["train"]["data_0"]
+        for key in ("selected", "on_time", "stragglers", "deadline_s",
+                    "round_close_s"):
+            assert f[key] == b[key], f"round {fh['round']}: {key}"
+        assert fh.get("pacing") == bh.get("pacing")
+        some_stragglers = some_stragglers or f["stragglers"] > 0
+    assert some_stragglers, "scenario never produced a straggler set"
+    for x, y in zip(_params(runner), _params(base)):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 

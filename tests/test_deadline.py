@@ -1,0 +1,364 @@
+"""Deadline-aware rounds: acceptance tests.
+
+- deadline-off path is bitwise identical to the deadline-free engine;
+- deadline-on aggregation matches an explicit-mask numpy oracle (only
+  clients with completion_time <= deadline contribute);
+- stragglers are reported distinctly from drops in per-round results,
+  telemetry counters, and get_performance();
+- over-selection + K-th-arrival round close;
+- quorum misses route through the FailurePolicy machinery as
+  ``deadline_miss`` events;
+- the adaptive controller's state survives checkpoint resume and repaces
+  deterministically;
+- the ``runner.straggler_spike`` injection point slows the fleet.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.engine.pacing import DeadlineConfig, DeadlineMissError
+from olearning_sim_tpu.engine.runner import (
+    DataPopulation,
+    OperatorSpec,
+    SimulationRunner,
+)
+from olearning_sim_tpu.parallel.mesh import global_put, make_mesh_plan
+from olearning_sim_tpu.performancemgr.performance_manager import PerformanceManager
+from olearning_sim_tpu.resilience import (
+    DEADLINE_MISS,
+    FailurePolicy,
+    FaultPlan,
+    FaultSpec,
+    ResilienceConfig,
+    ResilienceLog,
+    faults,
+)
+from olearning_sim_tpu.telemetry import MetricsRegistry
+
+NUM_CLIENTS = 16
+INPUT_SHAPE = (8,)
+
+
+@pytest.fixture(scope="module")
+def plan():
+    return make_mesh_plan()
+
+
+@pytest.fixture(scope="module")
+def core(plan):
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=4, block_clients=2)
+    return build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (8,), "num_classes": 3},
+        input_shape=INPUT_SHAPE,
+    )
+
+
+@pytest.fixture()
+def dataset(plan):
+    return make_synthetic_dataset(
+        7, NUM_CLIENTS, 6, INPUT_SHAPE, 3, class_sep=3.0
+    ).pad_for(plan, 2).place(plan)
+
+
+def _leaves(state):
+    return jax.tree.leaves(jax.device_get(state.params))
+
+
+# --------------------------------------------------------------- fedcore
+def test_deadline_off_path_is_bitwise_identical(core, dataset, plan):
+    """A non-binding deadline (inf) and the deadline-free program must agree
+    bitwise: masking with nothing masked leaves aggregation untouched."""
+    sh = plan.client_sharding()
+    comp = global_put(
+        np.arange(dataset.num_clients, dtype=np.float32), sh
+    )
+
+    base_state, base_metrics = core.round_step(
+        core.init_state(jax.random.key(0)), dataset
+    )
+    dl_state, dl_metrics = core.round_step(
+        core.init_state(jax.random.key(0)), dataset,
+        completion_time=comp, deadline=float("inf"),
+    )
+    for a, b in zip(_leaves(base_state), _leaves(dl_state)):
+        np.testing.assert_array_equal(a, b)
+    assert float(dl_metrics.stragglers) == 0.0
+    assert float(base_metrics.stragglers) == 0.0
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(base_metrics.client_loss)),
+        np.asarray(jax.device_get(dl_metrics.client_loss)),
+    )
+
+
+def test_deadline_masking_matches_explicit_mask_oracle(core, dataset, plan):
+    """In-jit deadline masking == pre-masking participation on the host:
+    only clients with completion_time <= deadline contribute, bitwise."""
+    sh = plan.client_sharding()
+    C = dataset.num_clients
+    rng = np.random.default_rng(5)
+    comp = rng.uniform(0.5, 4.0, size=C).astype(np.float32)
+    deadline = 2.0
+    on_time = (comp <= deadline).astype(np.float32)
+    assert 0 < on_time.sum() < C  # the deadline actually bites
+
+    dl_state, dl_metrics = core.round_step(
+        core.init_state(jax.random.key(1)), dataset,
+        completion_time=global_put(comp, sh), deadline=deadline,
+    )
+    oracle_state, oracle_metrics = core.round_step(
+        core.init_state(jax.random.key(1)), dataset,
+        participate=global_put(on_time, sh),
+    )
+    for a, b in zip(_leaves(dl_state), _leaves(oracle_state)):
+        np.testing.assert_array_equal(a, b)
+    # Straggler count matches the numpy oracle; weight sums agree.
+    weights = np.asarray(jax.device_get(dataset.weight))
+    expected_stragglers = int(((weights > 0) & (comp > deadline)).sum())
+    assert int(dl_metrics.stragglers) == expected_stragglers
+    assert float(dl_metrics.weight_sum) == pytest.approx(
+        float((weights * on_time).sum())
+    )
+    assert float(oracle_metrics.weight_sum) == float(dl_metrics.weight_sum)
+
+
+def test_deadline_requires_completion_time(core, dataset):
+    with pytest.raises(ValueError):
+        core.round_step(core.init_state(jax.random.key(0)), dataset,
+                        deadline=1.0)
+
+
+# ---------------------------------------------------------------- runner
+def make_runner(core, dataset, *, deadline=None, operators=None, rounds=3,
+                resilience=None, registry=None, perf=None, checkpointer=None,
+                task_id="dl-task", trace_seed=0):
+    cls = (np.arange(dataset.num_clients) >= NUM_CLIENTS // 2).astype(int)
+    pop = DataPopulation(
+        name="d0", dataset=dataset, device_classes=["fast", "slow"],
+        class_of_client=cls,
+        nums=[NUM_CLIENTS // 2, NUM_CLIENTS - NUM_CLIENTS // 2],
+        dynamic_nums=[0, 0],
+    )
+    return SimulationRunner(
+        task_id=task_id, core=core, populations=[pop],
+        operators=operators or [OperatorSpec(name="train")], rounds=rounds,
+        deadline=deadline, resilience=resilience, registry=registry,
+        perf=perf, checkpointer=checkpointer, trace_seed=trace_seed,
+    )
+
+
+# 4 local steps x 0.1s = 0.4s for fast clients; x 0.5s = 2.0s for slow.
+PROFILES = {"fast": 0.1, "slow": 0.5}
+
+
+def test_runner_reports_stragglers_distinct_from_drops(core, dataset):
+    """Slow-class clients miss the 1s deadline (stragglers); the trace drops
+    a further share of messages (drops). The two are reported distinctly in
+    per-round results, telemetry counters, and get_performance()."""
+    strategy = json.dumps({
+        "real_time_dispatch": {
+            "use_strategy": True,
+            "drop_simulation": {"drop_probability": 0.25},
+        }
+    })
+    registry = MetricsRegistry()
+    perf = PerformanceManager(registry=registry)
+    runner = make_runner(
+        core, dataset,
+        deadline=DeadlineConfig(deadline_s=1.0, speed_profiles=PROFILES),
+        operators=[OperatorSpec(name="train", use_deviceflow=True,
+                                deviceflow_strategy=strategy)],
+        registry=registry, perf=perf, rounds=2,
+    )
+    history = runner.run()
+    total_stragglers = total_drops = 0
+    for h in history:
+        rec = h["train"]["d0"]
+        assert rec["stragglers"] > 0      # slow class missed the deadline
+        assert rec["dropped"] > 0         # trace-level message loss
+        # Stragglers are a subset of the SELECTED cohort; drops never are.
+        assert rec["stragglers"] <= rec["selected"]
+        assert rec["on_time"] == rec["selected"] - rec["stragglers"]
+        assert rec["clients_trained"] == rec["on_time"]
+        assert rec["deadline_s"] == 1.0
+        total_stragglers += rec["stragglers"]
+        total_drops += rec["dropped"]
+    # Telemetry counters carry the same split.
+    strag = registry.counter(
+        "ols_engine_stragglers_total", labels=("task_id",)
+    ).labels(task_id="dl-task")
+    assert strag.value == total_stragglers
+    hist_metric = registry.histogram(
+        "ols_engine_completion_time_seconds", labels=("task_id",)
+    ).labels(task_id="dl-task")
+    assert hist_metric.count > 0
+    # ...and get_performance reports both, distinctly.
+    summary = perf.get_performance("dl-task")
+    assert summary["stragglers_total"] == total_stragglers
+    assert summary["dropped_total"] == total_drops
+    assert total_stragglers != total_drops  # genuinely different quantities
+
+
+def test_over_selection_and_kth_arrival_close(core, dataset):
+    """ceil(K(1+alpha)) clients are selected; the round closes at the K-th
+    simulated arrival when that beats the static deadline."""
+    dl = DeadlineConfig(deadline_s=100.0, speed_profiles=PROFILES,
+                        target_cohort=6, over_selection=0.5)
+    runner = make_runner(core, dataset, deadline=dl, rounds=1)
+    history = runner.run()
+    rec = history[0]["train"]["d0"]
+    assert rec["selected"] == 9  # ceil(6 * 1.5)
+    # The 6th-fastest completion closes the round long before 100s.
+    assert rec["deadline_s"] < 100.0
+    assert rec["on_time"] >= 6
+
+
+def test_quorum_miss_routes_through_failure_policy(core, dataset):
+    """A starved round (deadline below every completion time) fails quorum:
+    skip_round degrades gracefully with a deadline_miss event; with no
+    resilience config the DeadlineMissError surfaces (fail_task)."""
+    starved = DeadlineConfig(deadline_s=0.01, speed_profiles=PROFILES,
+                             quorum_fraction=0.5)
+    log = ResilienceLog()
+    runner = make_runner(
+        core, dataset, deadline=starved, rounds=2,
+        resilience=ResilienceConfig(
+            failure_policy=FailurePolicy.SKIP_ROUND, log=log,
+            quarantine_after=None,
+        ),
+    )
+    history = runner.run()
+    assert all(h.get("skipped") for h in history)
+    assert log.count(DEADLINE_MISS) == 2
+    miss = log.events(DEADLINE_MISS)[0]
+    assert miss.detail["on_time"] == 0
+    assert miss.detail["required"] >= 1
+
+    with pytest.raises(DeadlineMissError):
+        make_runner(core, dataset, deadline=starved, rounds=1,
+                    task_id="dl-fail").run()
+
+
+def test_adaptive_controller_repaces_after_checkpoint_resume(
+        core, dataset, tmp_path):
+    """Controller state rides the checkpointed history: an interrupted run
+    resumed from checkpoint repaces exactly like an uninterrupted one."""
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+
+    dl = DeadlineConfig(deadline_s=1.0, speed_profiles=PROFILES,
+                        adaptive=True, target_completion_fraction=0.9,
+                        ema_beta=0.5, jitter=0.3)
+
+    # Uninterrupted 4-round reference. Same task_id as the resumed run —
+    # the task id seeds the initial model.
+    ref = make_runner(core, dataset, deadline=dl, rounds=4,
+                      task_id="dl-resume")
+    ref_history = ref.run()
+
+    # Interrupted: 2 rounds, then a fresh runner resumes from checkpoint.
+    ck = str(tmp_path / "ck")
+    first = make_runner(core, dataset, deadline=dl, rounds=2,
+                        checkpointer=RoundCheckpointer(ck, max_to_keep=4),
+                        task_id="dl-resume")
+    first.run()
+    first.checkpointer.wait()
+    resumed = make_runner(core, dataset, deadline=dl, rounds=4,
+                          checkpointer=RoundCheckpointer(ck, max_to_keep=4),
+                          task_id="dl-resume")
+    resumed_history = resumed.run()
+
+    assert [h["round"] for h in resumed_history] == [0, 1, 2, 3]
+    for ref_h, res_h in zip(ref_history, resumed_history):
+        assert ref_h["pacing"] == res_h["pacing"]
+        ref_rec, res_rec = ref_h["train"]["d0"], res_h["train"]["d0"]
+        for key in ("selected", "on_time", "stragglers", "deadline_s"):
+            assert ref_rec[key] == res_rec[key], key
+    for a, b in zip(_leaves(ref.states["d0"]), _leaves(resumed.states["d0"])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_straggler_totals_not_double_counted_by_replays():
+    """A rolled-back round that replays records a second RoundTiming row for
+    the same (round, operator); get_performance must count its stragglers
+    once (last row wins), not once per execution."""
+    from olearning_sim_tpu.performancemgr.performance_manager import (
+        RoundTiming,
+    )
+
+    perf = PerformanceManager()
+    for _attempt in range(2):  # original execution + replay
+        perf.record_round(RoundTiming(
+            task_id="t", round_idx=0, operator="train", duration_s=1.0,
+            num_clients=8, local_steps=2,
+            extra={"stragglers": 3, "dropped": 1},
+        ))
+    perf.record_round(RoundTiming(
+        task_id="t", round_idx=1, operator="train", duration_s=1.0,
+        num_clients=8, local_steps=2, extra={"stragglers": 2, "dropped": 0},
+    ))
+    summary = perf.get_performance("t")
+    assert summary["stragglers_total"] == 5
+    assert summary["dropped_total"] == 1
+
+
+def test_malformed_deadline_params_rejected_at_submit():
+    """Wrong-shaped deadline blocks (valid JSON, wrong types) must come back
+    as clean validation failures from validate_task_parameters, never as an
+    unhandled server-side exception."""
+    import copy
+    import os
+
+    from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+    from olearning_sim_tpu.taskmgr.validation import validate_task_parameters
+
+    cfg_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "configs", "fedavg_mnist_mlp_deadline.json",
+    )
+    with open(cfg_path) as f:
+        base = json.load(f)
+    op_info = base["operatorflow"]["operators"][0]["logical_simulation"]
+    params = json.loads(op_info["operator_params"])
+    for bad in ("fast", {"speed_profiles": [1, 2]}, {"quorum_fraction": 2.0},
+                {"target_cohort": 0}):
+        tj = copy.deepcopy(base)
+        p2 = copy.deepcopy(params)
+        p2["deadline"] = bad
+        tj["operatorflow"]["operators"][0]["logical_simulation"][
+            "operator_params"] = json.dumps(p2)
+        ok, msg = validate_task_parameters(json2taskconfig(json.dumps(tj)))
+        assert not ok and "deadline" in msg, (bad, msg)
+    # The shipped config itself stays valid.
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(base)))
+    assert ok, msg
+
+
+def test_straggler_spike_injection_point(core, dataset):
+    """The runner.straggler_spike fault multiplies the round's completion
+    times: a fleet-wide slowdown turns every selected client into a
+    straggler for exactly the targeted round."""
+    log = ResilienceLog()
+    dl = DeadlineConfig(deadline_s=3.0, speed_profiles=PROFILES)
+    runner = make_runner(core, dataset, deadline=dl, rounds=3)
+    spike = FaultPlan(seed=11, specs=[
+        # Population scoping rides the spec's match filter (context is the
+        # population name): a spec for another population must not fire —
+        # and must not consume anything.
+        FaultSpec(point="runner.straggler_spike", rounds=[1],
+                  match="not-this-population", payload={"factor": 100.0}),
+        FaultSpec(point="runner.straggler_spike", rounds=[1], match="d0",
+                  payload={"factor": 100.0}),
+    ])
+    with faults.chaos(spike, log=log):
+        history = runner.run()
+    recs = [h["train"]["d0"] for h in history]
+    assert recs[0]["stragglers"] == 0            # 3s deadline covers 2s slow
+    assert recs[1]["stragglers"] == recs[1]["selected"]  # spiked round
+    assert recs[1]["clients_trained"] == 0
+    assert recs[2]["stragglers"] == 0            # spike was one round only
+    assert log.count("fault_injected") == 1

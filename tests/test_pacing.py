@@ -1,0 +1,244 @@
+"""Units for deadline-aware round pacing (engine/pacing.py) and the
+monotonic-clock satellites (utils/clocks.py + the barrier/retry call sites
+that previously measured timeouts with the wall clock)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.engine.pacing import (
+    DeadlineConfig,
+    DeadlineController,
+    completion_times,
+    effective_deadline,
+    select_cohort,
+)
+from olearning_sim_tpu.utils.clocks import Deadline, monotonic
+
+
+# ------------------------------------------------------------ DeadlineConfig
+def test_deadline_config_from_dict_roundtrip():
+    cfg = DeadlineConfig.from_dict({
+        "deadline_s": 30.0, "over_selection": 0.3, "target_cohort": 80,
+        "quorum_fraction": 0.5, "adaptive": True,
+        "target_completion_fraction": 0.9,
+        "speed_profiles": {"high": 0.05, "low": 0.4},
+        "default_step_s": 0.2, "jitter": 0.1,
+    })
+    assert cfg.deadline_s == 30.0
+    assert cfg.target_cohort == 80
+    assert cfg.speed_profiles == {"high": 0.05, "low": 0.4}
+    assert cfg.enabled
+
+
+def test_deadline_config_rejects_bad_fields():
+    with pytest.raises(ValueError):
+        DeadlineConfig(quorum_fraction=1.5)
+    with pytest.raises(ValueError):
+        DeadlineConfig(over_selection=-0.1)
+    with pytest.raises(ValueError):
+        DeadlineConfig(target_cohort=0)
+    with pytest.raises(ValueError):
+        DeadlineConfig(target_completion_fraction=0.0)
+    with pytest.raises(ValueError):
+        # np.clip(min > max) would silently answer max: reject up front.
+        DeadlineConfig(adaptive=True, max_deadline_s=-5.0)
+
+
+def test_deadline_config_rejects_unknown_and_nondict():
+    with pytest.raises(ValueError, match="quorum_fracton"):
+        DeadlineConfig.from_dict({"deadline_s": 30.0,
+                                  "quorum_fracton": 0.5})  # typo
+    with pytest.raises(TypeError):
+        DeadlineConfig.from_dict("fast")
+
+
+def test_deadline_config_disabled_by_default():
+    assert not DeadlineConfig().enabled
+
+
+# --------------------------------------------------------- completion model
+def test_completion_times_combine_arrival_and_compute():
+    cfg = DeadlineConfig(deadline_s=10.0,
+                         speed_profiles={"high": 0.1, "low": 1.0})
+    arrival = np.array([0.0, 2.0, np.inf, 0.5], np.float32)
+    steps = np.array([10, 10, 10, 4], np.int32)
+    cls = np.array([0, 1, 0, 1])
+    out = completion_times(arrival, steps, cls, ["high", "low"], cfg,
+                           seed=0, round_idx=0)
+    # high: 10 steps x 0.1 = 1.0s compute; low: 10 x 1.0 / 4 x 1.0.
+    np.testing.assert_allclose(out[0], 1.0)
+    np.testing.assert_allclose(out[1], 12.0)
+    assert np.isinf(out[2])  # never released stays never-completed
+    np.testing.assert_allclose(out[3], 4.5)
+
+
+def test_completion_times_unlisted_class_uses_default():
+    cfg = DeadlineConfig(deadline_s=1.0, default_step_s=0.5)
+    out = completion_times(np.zeros(2, np.float32), np.array([4, 2]),
+                           np.array([0, 0]), ["mystery"], cfg, 0, 0)
+    np.testing.assert_allclose(out, [2.0, 1.0])
+
+
+def test_completion_jitter_is_seeded_and_round_varying():
+    cfg = DeadlineConfig(deadline_s=1.0, default_step_s=1.0, jitter=0.5)
+    arrival = np.zeros(64, np.float32)
+    steps = np.ones(64, np.int32)
+    cls = np.zeros(64, int)
+    a = completion_times(arrival, steps, cls, ["c"], cfg, seed=3, round_idx=1)
+    b = completion_times(arrival, steps, cls, ["c"], cfg, seed=3, round_idx=1)
+    c = completion_times(arrival, steps, cls, ["c"], cfg, seed=3, round_idx=2)
+    np.testing.assert_array_equal(a, b)   # deterministic per (seed, round)
+    assert not np.array_equal(a, c)       # varies across rounds
+    assert (a >= 1.0).all() and (a <= 1.5).all()
+
+
+# ------------------------------------------------------------ over-selection
+def test_select_cohort_over_selects_ceil():
+    cfg = DeadlineConfig(target_cohort=10, over_selection=0.25)
+    eligible = np.ones(64, bool)
+    sel = select_cohort(eligible, cfg, seed=0, round_idx=0)
+    assert sel.sum() == 13  # ceil(10 * 1.25)
+    assert (eligible | ~sel).all()  # subset of eligible
+
+
+def test_select_cohort_takes_all_when_short():
+    cfg = DeadlineConfig(target_cohort=100, over_selection=0.5)
+    eligible = np.zeros(16, bool)
+    eligible[:5] = True
+    sel = select_cohort(eligible, cfg, seed=0, round_idx=0)
+    np.testing.assert_array_equal(sel, eligible)
+
+
+def test_select_cohort_deterministic_per_round():
+    cfg = DeadlineConfig(target_cohort=8)
+    eligible = np.ones(32, bool)
+    a = select_cohort(eligible, cfg, 7, 3)
+    b = select_cohort(eligible, cfg, 7, 3)
+    c = select_cohort(eligible, cfg, 7, 4)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_effective_deadline_closes_at_kth_arrival():
+    cfg = DeadlineConfig(target_cohort=3, deadline_s=100.0)
+    completion = np.array([5.0, 1.0, 9.0, 3.0, np.inf], np.float32)
+    selected = np.ones(5, bool)
+    # 3rd smallest completion is 5.0 — earlier than the 100s deadline.
+    assert effective_deadline(completion, selected, cfg, 100.0) == 5.0
+    # A tighter controller deadline wins.
+    assert effective_deadline(completion, selected, cfg, 2.0) == 2.0
+
+
+# -------------------------------------------------------------- controller
+def test_controller_static_passthrough():
+    ctl = DeadlineController(DeadlineConfig(deadline_s=7.0))
+    ctl.observe(np.array([1.0, 2.0]))
+    assert ctl.current_deadline() == 7.0  # not adaptive: observe is a no-op
+    assert ctl.state_dict() == {"ema": None}
+
+
+def test_controller_adaptive_tracks_percentile():
+    cfg = DeadlineConfig(adaptive=True, target_completion_fraction=0.5,
+                         ema_beta=0.5, margin=1.0)
+    ctl = DeadlineController(cfg)
+    assert ctl.current_deadline() == float("inf")  # warm-up: no observation
+    ctl.observe(np.array([1.0, 2.0, 3.0], np.float32))
+    assert ctl.current_deadline() == pytest.approx(2.0)
+    ctl.observe(np.array([4.0, 4.0, 4.0], np.float32))
+    # ema = 0.5*2.0 + 0.5*4.0
+    assert ctl.current_deadline() == pytest.approx(3.0)
+
+
+def test_controller_state_roundtrip_and_history_rehydrate():
+    cfg = DeadlineConfig(adaptive=True, ema_beta=1.0, margin=1.0)
+    ctl = DeadlineController(cfg)
+    ctl.observe(np.array([5.0], np.float32))
+    state = ctl.state_dict()
+
+    fresh = DeadlineController(cfg)
+    fresh.load_state(state)
+    assert fresh.current_deadline() == ctl.current_deadline()
+
+    hist = [{"round": 0, "pacing": {"ema": 2.5}},
+            {"round": 1},  # e.g. a skipped round carries no pacing state
+            {"round": 2, "pacing": {"ema": 4.0}}]
+    fresh.load_from_history(hist)
+    assert fresh.ema == 4.0
+    fresh.load_from_history([])
+    assert fresh.ema is None
+
+
+# ------------------------------------------- monotonic clock satellites
+def test_deadline_helper_ignores_wall_clock(monkeypatch):
+    d = Deadline(30.0)
+    # A forward wall-clock step (NTP) must not expire the countdown.
+    monkeypatch.setattr(time, "time", lambda: time.monotonic() + 1e9)
+    assert not d.expired()
+    assert d.remaining() > 29.0
+    assert Deadline(None).remaining() == float("inf")
+    assert Deadline(0.0).expired()
+
+
+def test_polling_barrier_survives_wall_clock_jump(monkeypatch):
+    """Regression (satellite): PollingRoundBarrier measured its timeout with
+    time.time(); an NTP step forward expired a live barrier instantly."""
+    from olearning_sim_tpu.taskmgr.operator_flow import PollingRoundBarrier
+
+    answers = iter([None, None, 6])
+    barrier = PollingRoundBarrier(lambda: next(answers))
+    # Jump the wall clock far into the future mid-poll.
+    monkeypatch.setattr(time, "time", lambda: time.monotonic() + 1e9)
+    ok, current = barrier.start({"wait_interval": 0.01, "total_timeout": 5})
+    assert ok and current == 6
+
+
+def test_polling_barrier_still_times_out():
+    from olearning_sim_tpu.taskmgr.operator_flow import PollingRoundBarrier
+
+    barrier = PollingRoundBarrier(lambda: None)
+    t0 = monotonic()
+    ok, _ = barrier.start({"wait_interval": 0.01, "total_timeout": 0.05})
+    assert not ok
+    assert monotonic() - t0 < 2.0  # expired promptly on the monotonic clock
+
+
+def test_flag_file_barrier_survives_wall_clock_jump(tmp_path, monkeypatch):
+    from olearning_sim_tpu.taskmgr.operator_flow import FlagFileBarrier
+
+    flag = tmp_path / "aggregation_finished.txt"
+    barrier = FlagFileBarrier(str(flag))
+    monkeypatch.setattr(time, "time", lambda: time.monotonic() + 1e9)
+
+    def write_flag():
+        time.sleep(0.05)
+        flag.write_text("done")
+
+    t = threading.Thread(target=write_flag)
+    t.start()
+    ok, _ = barrier.stop({"wait_interval": 0.01, "total_timeout": 5}, 0)
+    t.join()
+    assert ok
+    assert not flag.exists()  # consumed
+
+
+def test_retry_policy_deadline_on_monotonic_clock():
+    """RetryPolicy's deadline cap burns down on the shared monotonic helper:
+    exhaustion is reported with reason=deadline, and a wall-clock jump
+    (patched time.time) cannot expire the budget early."""
+    from olearning_sim_tpu.resilience import RETRY_EXHAUSTED, ResilienceLog
+    from olearning_sim_tpu.resilience.retry import RetryPolicy
+
+    log = ResilienceLog()
+    policy = RetryPolicy(max_attempts=10, base_delay=1.0, jitter=0.0,
+                         deadline=0.5, sleep=lambda _s: None)
+
+    def always_fails():
+        raise IOError("down")
+
+    with pytest.raises(IOError):
+        policy.call(always_fails, point="t", log=log)
+    ev = log.events(RETRY_EXHAUSTED)
+    assert len(ev) == 1 and ev[0].detail.get("reason") == "deadline"
