@@ -5,11 +5,27 @@ Checkpoint unit per round: ``{"states": {population: ServerState},
 sidecar with the round index and runner history. Typed PRNG keys are stored
 as raw key data (Orbax serializes arrays, not key types) and re-wrapped on
 restore.
+
+Crash-consistent commits: each saved step is committed by a checksummed
+manifest (``manifests/step-<n>.json``, one CRC32+size entry per step file)
+written tmp -> fsync -> ``os.replace`` -> fsync(dir) *after* the Orbax save
+fully lands. Restore verifies the manifest before touching a step: a step
+with a mismatching manifest is torn (host died mid-flush, bit rot, the
+``checkpoint.corrupt`` chaos point) and is skipped to the previous good
+step without ever being deserialized; a step with *no* manifest (pre-
+manifest build, or death between save and commit) is attempted under the
+legacy exception-fallback path.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import json
+import os
+import tempfile
+import threading
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -17,6 +33,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from olearning_sim_tpu.storage.file_repo import FileRepo
+from olearning_sim_tpu.utils.durable import atomic_write_bytes
 
 
 def _is_key(x) -> bool:
@@ -71,6 +88,10 @@ class RoundCheckpointer:
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        # In-flight manifest commit (one at a time; joined before any other
+        # manager interaction, so the orbax handle is never used from two
+        # threads at once).
+        self._manifest_thread: Optional[threading.Thread] = None
 
     def _call(self, point: str, fn, *args, **kwargs):
         from olearning_sim_tpu.resilience import NO_RETRY, faults
@@ -98,6 +119,10 @@ class RoundCheckpointer:
             "personal": _strip_keys(personal),
         }
         meta = {"round_idx": int(round_idx), "history": _jsonable(history)}
+        # The orbax manager is single-threaded by contract: the previous
+        # step's manifest commit must finish before this save touches it
+        # (and before max_to_keep GC can delete the step mid-checksum).
+        self._join_manifest()
         t0 = time.perf_counter()
         self._call(
             "checkpoint.save",
@@ -117,6 +142,7 @@ class RoundCheckpointer:
                    self.registry).labels(
             task_id=self.task_id
         ).inc(_tree_bytes(payload))
+        self._start_manifest_commit(round_idx)
         self._maybe_corrupt(round_idx)
 
     def _maybe_corrupt(self, round_idx: int) -> None:
@@ -130,8 +156,11 @@ class RoundCheckpointer:
                            round_idx=round_idx, task_id=self.task_id)
         if spec is None:
             return
-        import os
-
+        # Land the manifest BEFORE truncating, so the corruption is
+        # deterministically a post-commit tear (manifest mismatch at
+        # restore) — racing the commit thread would make chaos replay
+        # outcome-dependent on scheduling.
+        self._join_manifest()
         self._mgr.wait_until_finished()
         step_dir = os.path.join(self.directory, str(round_idx))
         largest, size = None, -1
@@ -145,7 +174,102 @@ class RoundCheckpointer:
             with open(largest, "r+b") as f:
                 f.truncate(max(0, size // 2))
 
+    # ---------------------------------------------------- manifest commits
+    def _start_manifest_commit(self, round_idx: int) -> None:
+        """Commit the step's manifest off the hot path: the checksum pass
+        re-reads the whole step from disk, which must not serialize the
+        round loop (orbax saves were async before manifests and stay
+        effectively async — the commit thread does the flush wait). At most
+        one commit is in flight; every other manager interaction joins it
+        first. A failed commit leaves the step manifest-less = the legacy
+        attempt-and-catch restore path, a safe degradation."""
+        self._join_manifest()
+
+        def commit():
+            with contextlib.suppress(Exception):
+                self._commit_manifest(round_idx)
+
+        t = threading.Thread(target=commit, name="ckpt-manifest-commit",
+                             daemon=True)
+        t.start()
+        self._manifest_thread = t
+
+    def _join_manifest(self) -> None:
+        t, self._manifest_thread = self._manifest_thread, None
+        if t is not None:
+            t.join()
+
+    def _manifest_path(self, round_idx: int) -> str:
+        return os.path.join(self.directory, "manifests",
+                            f"step-{int(round_idx)}.json")
+
+    def _step_checksums(self, round_idx: int) -> Dict[str, List[int]]:
+        """{relative file path: [size, crc32]} over the step directory."""
+        step_dir = os.path.join(self.directory, str(int(round_idx)))
+        files: Dict[str, List[int]] = {}
+        for dirpath, _dirs, names in os.walk(step_dir):
+            for name in sorted(names):
+                path = os.path.join(dirpath, name)
+                crc = 0
+                with open(path, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        crc = zlib.crc32(chunk, crc)
+                files[os.path.relpath(path, step_dir)] = [
+                    os.path.getsize(path), crc
+                ]
+        return files
+
+    def _commit_manifest(self, round_idx: int) -> None:
+        """The durable commit point for a step: block until Orbax finished
+        flushing it, checksum every file, and land the manifest with full
+        tmp -> fsync -> replace -> fsync(dir) discipline. A step without a
+        valid manifest was never committed."""
+        self._mgr.wait_until_finished()
+        payload = {
+            "round_idx": int(round_idx),
+            "files": self._step_checksums(round_idx),
+        }
+        atomic_write_bytes(
+            self._manifest_path(round_idx),
+            json.dumps(payload, sort_keys=True).encode("utf-8"),
+        )
+        self._reap_stale_manifests()
+
+    def _reap_stale_manifests(self) -> None:
+        """Drop manifests whose step Orbax already garbage-collected
+        (max_to_keep) so the manifests dir cannot grow without bound."""
+        mdir = os.path.join(self.directory, "manifests")
+        if not os.path.isdir(mdir):
+            return
+        live = {str(int(s)) for s in self._mgr.all_steps()}
+        for name in os.listdir(mdir):
+            if not (name.startswith("step-") and name.endswith(".json")):
+                continue
+            if name[len("step-"):-len(".json")] not in live:
+                with contextlib.suppress(OSError):
+                    os.remove(os.path.join(mdir, name))
+
+    def verify_step(self, round_idx: int) -> Optional[bool]:
+        """Manifest verdict for a retained step: ``True`` committed and
+        intact, ``False`` torn (manifest/checksum mismatch — never
+        deserialize it), ``None`` no manifest (legacy step; attempt with
+        the exception-fallback path)."""
+        path = self._manifest_path(round_idx)
+        if not os.path.isfile(path):
+            return None
+        try:
+            with open(path, encoding="utf-8") as f:
+                manifest = json.load(f)
+            expected = manifest["files"]
+        except (OSError, ValueError, KeyError):
+            return False  # torn manifest: the commit itself is suspect
+        return expected == self._step_checksums(round_idx)
+
     def wait(self) -> None:
+        self._join_manifest()
         self._mgr.wait_until_finished()
 
     # ----------------------------------------------------------- restore
@@ -170,6 +294,7 @@ class RoundCheckpointer:
         from olearning_sim_tpu.resilience import CHECKPOINT_FALLBACK
         from olearning_sim_tpu.resilience.events import global_log
 
+        self._join_manifest()
         steps = sorted((int(s) for s in self._mgr.all_steps()), reverse=True)
         if not steps:
             return None
@@ -187,6 +312,17 @@ class RoundCheckpointer:
 
         log = self.log if self.log is not None else global_log()
         for step in steps:
+            verdict = self.verify_step(step)
+            if verdict is False:
+                # Torn/partial commit (host died mid-flush, or corruption):
+                # skip to the previous good step without deserializing it.
+                log.record(
+                    CHECKPOINT_FALLBACK, point="checkpoint.manifest",
+                    task_id=self.task_id, round_idx=int(step),
+                    error="manifest mismatch: torn or corrupt step",
+                    remaining_steps=len([s for s in steps if s < step]),
+                )
+                continue
             t0 = time.perf_counter()
             try:
                 try:
@@ -237,9 +373,16 @@ class RoundCheckpointer:
         """Delete retained steps newer than ``round_idx`` (rollback-replay:
         stale/corrupt future checkpoints must not shadow the replayed
         rounds). Returns the discarded steps."""
+        self._join_manifest()
         discarded = []
         for step in sorted(int(s) for s in self._mgr.all_steps()):
             if step > round_idx:
+                # Step FIRST, manifest second: a manifest-less-but-intact
+                # step is still attempted by restore (legacy/None verdict),
+                # so the reverse order would let a crash mid-discard
+                # resurrect the very checkpoint being discarded. A crash
+                # after the step delete merely leaves an orphan manifest,
+                # which verification never consults and the reaper removes.
                 try:
                     self._mgr.delete(step)
                     discarded.append(step)
@@ -252,9 +395,12 @@ class RoundCheckpointer:
                         f"{self.directory}/{step}", ignore_errors=True
                     )
                     discarded.append(step)
+                with contextlib.suppress(OSError):
+                    os.remove(self._manifest_path(step))
         return discarded
 
     def close(self) -> None:
+        self._join_manifest()
         self._mgr.close()
 
 
@@ -305,7 +451,9 @@ class ModelUpdateExporter:
     repo: FileRepo
     task_id: str
     update_style: str = "{task_id}_{round}_result_model.msgpack"
-    scratch_dir: str = "/tmp"
+    # The platform-appropriate temp dir (honors TMPDIR), not a hardcoded
+    # "/tmp" that breaks on hosts without one.
+    scratch_dir: str = dataclasses.field(default_factory=tempfile.gettempdir)
 
     def _name(self, round_idx: int) -> str:
         # {current_round} is the reference's placeholder spelling

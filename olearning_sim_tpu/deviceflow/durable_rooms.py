@@ -27,13 +27,14 @@ import time
 from typing import Any, List, Optional
 
 from olearning_sim_tpu.deviceflow.rooms import Message
+from olearning_sim_tpu.utils.repo import connect_sqlite
 
 
 def _connect(path: str) -> sqlite3.Connection:
-    conn = sqlite3.connect(path, check_same_thread=False)
-    conn.execute("PRAGMA journal_mode=WAL")
-    conn.execute("PRAGMA synchronous=NORMAL")
-    return conn
+    # Shared control-plane sqlite discipline (WAL + busy_timeout): the
+    # supervisor re-attaching a durable room while the dispatcher thread
+    # drains it must wait, not raise "database is locked".
+    return connect_sqlite(path)
 
 
 class SqliteInboundRoom:

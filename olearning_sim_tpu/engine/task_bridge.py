@@ -21,8 +21,17 @@ Arbitrary user code still works through the ``custom`` operator kind
       "data":      {"synthetic": {"seed": 0, "n_local": 20, "num_classes": 10,
                     "dirichlet_alpha": null, "class_sep": 2.0}, "eval_n": 1024},
       "resilience": { ...ResilienceConfig.from_dict... },    # docs/resilience.md
-      "deadline":   { ...DeadlineConfig.from_dict... }       # deadline-aware rounds
+      "deadline":   { ...DeadlineConfig.from_dict... },      # deadline-aware rounds
+      "checkpoint": {"directory": "/ckpts/{task_id}",        # crash-safe resume
+                     "every": 1, "max_to_keep": 3}
     }
+
+The ``checkpoint`` block is what makes a task supervisable: it gives the
+runner a ``RoundCheckpointer`` rooted at a durable per-task directory
+(``{task_id}`` is substituted; relative/omitted directories land under the
+system temp dir), so a relaunch of the same task — crash recovery through
+``supervisor.TaskSupervisor``, or a plain restart — resumes from the last
+committed round instead of replaying from zero.
 """
 
 from __future__ import annotations
@@ -364,6 +373,39 @@ def build_runner_from_taskconfig(
 
         resilience = ResilienceConfig.from_dict(params["resilience"])
 
+    # Crash-safe resume: the checkpoint block builds the runner's
+    # RoundCheckpointer unless the caller already injected one. Directory
+    # is per-task ({task_id} substituted) so two tasks never share steps.
+    # ``every`` applies either way — an injected checkpointer must not
+    # silently force per-round cadence.
+    ckpt_cfg = params.get("checkpoint")
+    checkpoint_every = int(ckpt_cfg.get("every", 1)) if ckpt_cfg else 1
+    if checkpointer is None and ckpt_cfg:
+        import tempfile
+
+        from olearning_sim_tpu.checkpoint import RoundCheckpointer
+
+        task_id = tc.taskID.taskID
+        # str.replace, not .format: a path with any other brace (literal or
+        # foreign placeholder) must pass through, not raise.
+        directory = str(ckpt_cfg.get("directory") or "").replace(
+            "{task_id}", task_id
+        )
+        if not directory:
+            directory = os.path.join(
+                tempfile.gettempdir(), "ols_checkpoints", task_id
+            )
+        elif not os.path.isabs(directory):
+            # Anchor relative paths: a supervisor relaunch from a different
+            # CWD must open the SAME directory or it would silently resume
+            # from round 0.
+            directory = os.path.join(tempfile.gettempdir(), directory)
+        checkpointer = RoundCheckpointer(
+            directory,
+            max_to_keep=int(ckpt_cfg.get("max_to_keep", 3)),
+            task_id=task_id,
+        )
+
     # Deadline-aware rounds ride the same blob (docs/resilience.md):
     #   {"deadline": {"deadline_s": 30.0, "over_selection": 0.3,
     #                 "target_cohort": 80, "quorum_fraction": 0.5,
@@ -387,6 +429,7 @@ def build_runner_from_taskconfig(
         stop_event=stop_event,
         perf=perf,
         checkpointer=checkpointer,
+        checkpoint_every=checkpoint_every,
         model_io=model_io,
         warm_start_path=warm_start_path,
         resilience=resilience,

@@ -17,8 +17,10 @@ TPU engine (docs/resilience.md):
 
 from olearning_sim_tpu.resilience.events import (
     CHECKPOINT_FALLBACK,
+    CRASH_LOOP,
     DEADLINE_MISS,
     FAULT_INJECTED,
+    LEASE_EXPIRED,
     OUTBOUND_DEGRADED,
     QUARANTINE,
     READMIT,
@@ -26,6 +28,7 @@ from olearning_sim_tpu.resilience.events import (
     RETRY_EXHAUSTED,
     ROLLBACK,
     SKIP_ROUND,
+    TASK_RESUMED,
     ResilienceEvent,
     ResilienceLog,
     global_log,
@@ -53,8 +56,10 @@ from olearning_sim_tpu.resilience.retry import (
 
 __all__ = [
     "CHECKPOINT_FALLBACK",
+    "CRASH_LOOP",
     "DEADLINE_MISS",
     "FAULT_INJECTED",
+    "LEASE_EXPIRED",
     "OUTBOUND_DEGRADED",
     "QUARANTINE",
     "READMIT",
@@ -62,6 +67,7 @@ __all__ = [
     "RETRY_EXHAUSTED",
     "ROLLBACK",
     "SKIP_ROUND",
+    "TASK_RESUMED",
     "ChaosClock",
     "FailurePolicy",
     "FaultError",

@@ -48,11 +48,21 @@ class SimulatorSession:
         performance_manager=None,
         max_workers: int = 16,
         metrics_port: Optional[int] = None,
+        supervisor=None,
+        supervise: bool = True,
     ):
         """``metrics_port`` — when set, start() also serves the telemetry
         registry on ``127.0.0.1:<metrics_port>`` (``/metrics`` Prometheus
         text, ``/metrics.json`` snapshot; 0 binds an ephemeral port,
-        readable from ``session.metrics_server.port``)."""
+        readable from ``session.metrics_server.port``).
+
+        ``supervisor`` / ``supervise`` — crash-safe task supervision
+        (docs/resilience.md): when the session hosts a task manager and
+        ``supervise`` is on, a :class:`~olearning_sim_tpu.supervisor.
+        TaskSupervisor` (the given one, or a default over the manager)
+        starts/stops with the session, and a session-built manager recovers
+        resume-first (orphaned RUNNING rows are left for the supervisor to
+        reclaim instead of being failed on boot)."""
         self.services = tuple(services)
         self.address = address
         self._server: Optional[grpc.Server] = None
@@ -88,7 +98,22 @@ class SimulatorSession:
                 deviceflow=deviceflow,
                 phone_client=phone_farm,
                 perf=performance_manager,
+                supervise_orphans=supervise,
             )
+        if (supervise and "taskmgr" in self.services
+                and task_manager is not None):
+            # A user-supplied manager must share the session's resume-first
+            # posture, or its release loop would MISSING-fail orphans ahead
+            # of the supervisor's reclaim. (Boot-time `_recover` already ran
+            # at THAT manager's construction — managers built for a
+            # supervised session should pass supervise_orphans=True
+            # themselves to also recover resume-first.)
+            task_manager._supervise_orphans = True
+            if supervisor is None:
+                from olearning_sim_tpu.supervisor import TaskSupervisor
+
+                supervisor = TaskSupervisor(task_manager)
+        self.supervisor = supervisor
 
         self.task_manager = task_manager
         self.resource_manager = resource_manager
@@ -109,6 +134,8 @@ class SimulatorSession:
 
             add_taskmgr_to_server(TaskMgrServicer(self.task_manager), server)
             self.task_manager.start()
+            if self.supervisor is not None:
+                self.supervisor.start()
         if "resourcemgr" in self.services and self.resource_manager is not None:
             add_service_to_server(ResourceMgrServicer(self.resource_manager), server)
         if "deviceflow" in self.services and self.deviceflow is not None:
@@ -141,6 +168,8 @@ class SimulatorSession:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
+        if self.supervisor is not None:
+            self.supervisor.stop()
         if self.task_manager is not None and hasattr(self.task_manager, "stop"):
             self.task_manager.stop()
         if self.deviceflow is not None and hasattr(self.deviceflow, "stop"):

@@ -88,12 +88,20 @@ class LocalFileRepo(FileRepo):
             # Stage-then-rename: a concurrent reader of ``dest`` must never
             # see a half-copied file (os.replace is atomic within one fs);
             # unique staging name so two uploaders don't clobber each other.
+            # The staged data is fsynced before the rename and the parent
+            # directory after it — without both, a host crash can replay the
+            # rename but not the data and "commit" a zero-length/torn file.
             fd, tmp = tempfile.mkstemp(
                 prefix=os.path.basename(dest) + ".", dir=os.path.dirname(dest) or "."
             )
             os.close(fd)
-            shutil.copyfile(local_path, tmp)
-            os.replace(tmp, dest)
+            from olearning_sim_tpu.utils.durable import (
+                commit_replace,
+                copy_file_durable,
+            )
+
+            copy_file_durable(local_path, tmp)
+            commit_replace(tmp, dest)
             return True
         except OSError:
             return False

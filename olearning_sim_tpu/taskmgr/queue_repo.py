@@ -23,9 +23,10 @@ from __future__ import annotations
 
 import abc
 import collections
-import sqlite3
 import threading
 from typing import List, Optional
+
+from olearning_sim_tpu.utils.repo import connect_sqlite
 
 
 class QueueRepo(abc.ABC):
@@ -82,7 +83,10 @@ class SqliteQueueRepo(QueueRepo):
         self._path = path
         self._table = table
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        # Shared helper: WAL + busy_timeout, so a producer process pushing
+        # while the manager's schedule daemon pops never sees
+        # "database is locked".
+        self._conn = connect_sqlite(path)
         with self._lock:
             self._conn.execute(
                 f"CREATE TABLE IF NOT EXISTS {table} "

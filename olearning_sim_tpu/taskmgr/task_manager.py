@@ -98,10 +98,24 @@ class TaskManager:
         intake_queue=None,
         retry_policy=None,
         resilience_log=None,
+        owner_id: Optional[str] = None,
+        lease_ttl: float = 60.0,
+        heartbeat_interval: Optional[float] = None,
+        supervise_orphans: bool = False,
     ):
         """``runner_factory(task_config, task_repo, deviceflow, stop_event)``
         builds the engine runner for a scheduled task; defaults to the
-        task-bridge builtin-operator path."""
+        task-bridge builtin-operator path.
+
+        Lease-based ownership (docs/resilience.md "Leases, supervision &
+        crash recovery"): every launched task is claimed under ``owner_id``
+        with a ``lease_ttl``-second lease the heartbeat daemon renews
+        (every ``heartbeat_interval`` seconds, default ``lease_ttl / 3``)
+        while the engine job is live. ``supervise_orphans=True`` makes boot
+        recovery leave orphaned RUNNING rows for a
+        :class:`~olearning_sim_tpu.supervisor.TaskSupervisor` to reclaim
+        and resume from checkpoint; False (the standalone default) keeps
+        the legacy release-and-fail recovery."""
         self.logger = logger if logger is not None else Logger()
         self._task_repo = task_repo if task_repo is not None else TaskTableRepo()
         self._resource_manager = resource_manager
@@ -118,6 +132,15 @@ class TaskManager:
         self._interrupt_queue_time = interrupt_queue_time
         self._interrupt_running_time = interrupt_running_time
         self._auto_create_rows = auto_create_rows
+        from olearning_sim_tpu.taskmgr.task_repo import make_owner_id
+
+        self.owner_id = owner_id if owner_id is not None else make_owner_id()
+        self.lease_ttl = float(lease_ttl)
+        self._heartbeat_interval = (
+            heartbeat_interval if heartbeat_interval is not None
+            else self.lease_ttl / 3.0
+        )
+        self._supervise_orphans = supervise_orphans
         # Transient-failure discipline for job submission and device-half
         # polling (ISSUE: resilience layer). Default: one retry with a short
         # backoff — enough to ride out a scheduler hiccup without changing
@@ -136,6 +159,15 @@ class TaskManager:
         # ``utils_redis.py:16-48``): a QueueRepo of task-JSON payloads
         # drained by the schedule daemon through the normal submit path.
         self._intake_queue = intake_queue
+        # task_id -> job_id for jobs THIS manager launched: the heartbeat's
+        # scope. The row's job_id column cannot be it — a supervisor
+        # reclaiming the task overwrites that column, which is exactly when
+        # fencing must still see (and stop) our original job.
+        self._own_jobs: Dict[str, str] = {}
+        # Tasks fenced away from this manager (lease stolen while our job
+        # was live): local resources were released at fencing time and the
+        # row now belongs to the reclaimer — our daemons must not write it.
+        self._fenced: set = set()
         # (task_id, data_name) -> staged device-shard path (hybrid split)
         self._device_paths: dict = {}
         self._lock = threading.RLock()
@@ -147,10 +179,14 @@ class TaskManager:
     def _recover(self) -> None:
         """Boot recovery (reference ``get_taskqueue_from_repo``,
         ``task_manager.py:89-155``): re-queue QUEUED rows ordered by
-        in_queue_time; rows whose resources were frozen at crash time have
-        lost their in-process job, so they are released and failed (the
-        reference re-adopts them into the release loop, which stops and
-        releases them the same way)."""
+        in_queue_time. Orphaned RUNNING rows (their engine job died with the
+        previous process) are handled by posture:
+
+        - ``supervise_orphans=True`` — resume-first: leave the row RUNNING
+          with its (now expiring) lease; the supervisor reclaims it and
+          relaunches through the checkpoint resume path;
+        - ``supervise_orphans=False`` — legacy fail-fast: release frozen
+          resources and mark FAILED (the pre-lease behavior)."""
         rows = sorted(
             (r for r in self._task_repo.query_all() if r.get("task_params")),
             key=lambda r: r.get("in_queue_time") or "",
@@ -167,6 +203,16 @@ class TaskManager:
                         task_id=task_id, system_name="TaskMgr",
                         module_name="recover", message=f"requeue failed: {e}",
                     )
+            elif self._supervise_orphans and (
+                status == TaskStatus.RUNNING.name
+                or str(row.get("resource_occupied")) == "1"
+            ):
+                self.logger.info(
+                    task_id=task_id, system_name="TaskMgr",
+                    module_name="recover",
+                    message="orphaned RUNNING task left for the supervisor "
+                            "to reclaim on lease expiry",
+                )
             elif str(row.get("resource_occupied")) == "1":
                 self.logger.error(
                     task_id=task_id, system_name="TaskMgr", module_name="recover",
@@ -616,6 +662,26 @@ class TaskManager:
                 self._resource_manager.release_resource(task_id)
             repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
             return
+        # Ownership BEFORE launch and BEFORE the RUNNING write: a RUNNING
+        # row with no lease reads as expired, so writing status first would
+        # open a window where a supervisor reclaims (and relaunches) the
+        # task while our job is coming up. A failed claim means another
+        # process holds a live lease on this task — refuse the double
+        # launch outright.
+        if not self._task_repo.claim_lease(task_id, self.owner_id,
+                                           self.lease_ttl):
+            self.logger.error(
+                task_id=task_id, system_name="TaskMgr", module_name="submit",
+                message="another process holds a live lease on this task; "
+                        "refusing to double-launch",
+            )
+            if self._phone_client is not None and \
+                    repo.get_item_value(task_id, "device_target"):
+                self._phone_client.stop_device(task_id)
+            if self._resource_manager is not None:
+                self._resource_manager.release_resource(task_id)
+            repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
+            return
         try:
             from olearning_sim_tpu.resilience import faults
 
@@ -657,11 +723,15 @@ class TaskManager:
             if self._resource_manager is not None:
                 self._resource_manager.release_resource(task_id)
             repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
+            self._task_repo.release_lease(task_id, self.owner_id)
             return
         repo.set_item_value(task_id, "job_id", job_id)
         repo.set_item_value(task_id, "task_status", TaskStatus.RUNNING.name)
         repo.set_item_value(task_id, "resource_occupied", "1")
         repo.set_item_value(task_id, "submit_task_time", time.strftime("%Y-%m-%d %H:%M:%S"))
+        # The heartbeat daemon renews the lease claimed above while the job
+        # lives; if this process dies, expiry is the supervisor's signal.
+        self._own_jobs[task_id] = job_id
 
     # ------------------------------------------------------- release/interrupt
     def release_once(self) -> None:
@@ -672,7 +742,18 @@ class TaskManager:
             if str(row.get("resource_occupied")) != "1":
                 continue
             task_id = row["task_id"]
+            if task_id in self._fenced:
+                # Another process reclaimed this task (heartbeat fencing):
+                # the row — including its final status — is theirs to write.
+                continue
             job_id = row.get("job_id")
+            if self._supervise_orphans and job_id and \
+                    self._launcher.get_job(job_id) is None:
+                # Resume-first posture: a job id our launcher has never seen
+                # is an orphan awaiting the supervisor (or a supervisor's
+                # relaunch in another process) — MISSING-failing it here
+                # would beat the reclaim to the row.
+                continue
             status = self._launcher.get_job_status(job_id) if job_id else TaskStatus.FAILED
             if status in (TaskStatus.PENDING, TaskStatus.RUNNING):
                 continue
@@ -692,6 +773,76 @@ class TaskManager:
             self._task_repo.set_item_value(
                 task_id, "task_finished_time", time.strftime("%Y-%m-%d %H:%M:%S")
             )
+            self._task_repo.release_lease(task_id, self.owner_id)
+            self._own_jobs.pop(task_id, None)
+            self._cleanup_hybrid_staging(task_id)
+
+    def heartbeat_once(self, now: Optional[float] = None) -> None:
+        """Renew the lease of every task this process owns whose engine job
+        is live. A failed renewal means another process stole the lease
+        (this process was presumed dead — e.g. it wedged past the TTL):
+        fence ourselves by stopping the job, so exactly one process ever
+        drives a task (the reclaimer's resumed job is now the task of
+        record)."""
+        now = now if now is not None else time.time()
+        # Scope: jobs THIS manager launched (not the row's job_id column —
+        # a supervisor reclaim overwrites that, and fencing must still see
+        # our original job then). Renewal continues while the row is still
+        # occupied even after the job goes terminal: the release loop can
+        # legitimately hold a finished task occupied past the TTL (deviceflow
+        # drain gate), and an expired lease would invite a pointless reclaim
+        # of a completed task. release_once pops the entry at finalization.
+        for task_id, job_id in list(self._own_jobs.items()):
+            status = self._launcher.get_job_status(job_id)
+            if self._task_repo.renew_lease(
+                task_id, self.owner_id, self.lease_ttl, now=now
+            ):
+                continue
+            # Renewal failed: confirm before acting — a transient DB error
+            # also answers False, and killing a healthy job over a DB blip
+            # (then resuming it from checkpoint) would burn resume budget
+            # for nothing.
+            owner, _ = self._task_repo.lease_info(task_id)
+            if owner == self.owner_id:
+                self.logger.warning(
+                    task_id=task_id, system_name="TaskMgr",
+                    module_name="heartbeat",
+                    message="lease renewal failed but we still own the row "
+                            "(transient repo error?); retrying next beat",
+                )
+                continue
+            if owner == "":
+                # Unowned: nothing else is driving the task — re-establish
+                # rather than fence (fencing would kill a healthy job).
+                self._task_repo.claim_lease(task_id, self.owner_id,
+                                            self.lease_ttl, now=now)
+                continue
+            if status not in (TaskStatus.PENDING, TaskStatus.RUNNING):
+                # Terminal job whose row another process took over: stand
+                # down — the new owner writes the final status — but OUR
+                # frozen resources and staging are still ours to release
+                # (release_once skips fenced rows and would otherwise leak
+                # them forever).
+                self._own_jobs.pop(task_id, None)
+                self._fenced.add(task_id)
+                if self._resource_manager is not None:
+                    self._resource_manager.release_resource(task_id)
+                self._cleanup_hybrid_staging(task_id)
+                continue
+            self.logger.error(
+                task_id=task_id, system_name="TaskMgr",
+                module_name="heartbeat",
+                message="lease stolen (this process was presumed dead); "
+                        "fencing: stopping the local engine job",
+            )
+            self._launcher.stop_job(job_id)
+            self._own_jobs.pop(task_id, None)
+            # Hand the row over wholesale: release OUR frozen resources
+            # and staging, and never let release_once overwrite the
+            # reclaimer's status with our stopped job's.
+            self._fenced.add(task_id)
+            if self._resource_manager is not None:
+                self._resource_manager.release_resource(task_id)
             self._cleanup_hybrid_staging(task_id)
 
     def interrupt_once(self, now: Optional[float] = None) -> None:
@@ -720,6 +871,7 @@ class TaskManager:
             (self.schedule_once, self._schedule_interval, "taskmgr-schedule"),
             (self.release_once, self._release_interval, "taskmgr-release"),
             (self.interrupt_once, self._interrupt_interval, "taskmgr-interrupt"),
+            (self.heartbeat_once, self._heartbeat_interval, "taskmgr-heartbeat"),
         ):
             t = threading.Thread(
                 target=self._loop, args=(fn, interval), name=name, daemon=True

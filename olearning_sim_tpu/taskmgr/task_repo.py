@@ -8,9 +8,23 @@ interface over a pluggable TableRepo backend.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+import time
+from typing import Any, List, Optional, Tuple
 
 from olearning_sim_tpu.utils.repo import MemoryTableRepo, SqliteTableRepo, TableRepo
+
+def make_owner_id(prefix: str = "") -> str:
+    """Lease identity: host:pid plus a random token, so two owners in one
+    process (tests, embedded deployments) are still distinct. The single
+    recipe shared by TaskManager and TaskSupervisor — identity semantics
+    must never diverge between the two sides of the lease protocol."""
+    import os
+    import socket
+    import uuid
+
+    base = f"{socket.gethostname()}:{os.getpid()}:{uuid.uuid4().hex[:8]}"
+    return f"{prefix}:{base}" if prefix else base
+
 
 TASK_COLUMNS = [
     "task_id",
@@ -29,6 +43,9 @@ TASK_COLUMNS = [
     "job_id",
     "resilience",         # JSON digest of resilience counters/events (runner)
     "resource_occupied",
+    "owner_id",           # lease: process owning the task's engine job
+    "lease_expires",      # lease: epoch seconds (repr(float)) the lease dies
+    "supervision",        # JSON {"resumes": n, "last_resume_ts": t} (supervisor)
     "in_queue_time",
     "submit_task_time",
     "task_finished_time",
@@ -81,6 +98,58 @@ class TaskTableRepo:
 
     def delete_task(self, task_id: str) -> bool:
         return self.backend.delete_items(task_id=task_id)
+
+    # ------------------------------------------------------------------ leases
+    # Lease-based ownership: exactly one process may own a task's engine job
+    # at a time. The claim/renew CAS lives in the backend (TableRepo.claim_row)
+    # so two managers racing on one sqlite/MySQL file cannot both win.
+    def claim_lease(self, task_id: str, owner_id: str, ttl_s: float,
+                    now: Optional[float] = None) -> bool:
+        """Take (or extend) the task's lease. Succeeds when the task is
+        unowned, already ours, or its lease expired before ``now``."""
+        now = time.time() if now is None else now
+        return self.backend.claim_row(
+            "task_id", task_id, "owner_id", owner_id,
+            "lease_expires", now + ttl_s, now, steal=True,
+        )
+
+    def renew_lease(self, task_id: str, owner_id: str, ttl_s: float,
+                    now: Optional[float] = None) -> bool:
+        """Extend the lease iff we still own it. A False answer means
+        another process reclaimed the task — the caller must fence itself
+        (stop its job), not keep running a task it no longer owns."""
+        now = time.time() if now is None else now
+        return self.backend.claim_row(
+            "task_id", task_id, "owner_id", owner_id,
+            "lease_expires", now + ttl_s, now, steal=False,
+        )
+
+    def release_lease(self, task_id: str, owner_id: str) -> bool:
+        """Drop the lease iff we still own it (task finished or handed off).
+        Atomic in the backend: a release racing a steal must never wipe the
+        new owner's live lease."""
+        return self.backend.release_row(
+            "task_id", task_id, "owner_id", owner_id, "lease_expires"
+        )
+
+    def lease_info(self, task_id: str) -> Tuple[str, Optional[float]]:
+        """(owner_id, lease_expires) — expires None when unset/unparseable."""
+        owner = self.get_item_value(task_id, "owner_id") or ""
+        raw = self.get_item_value(task_id, "lease_expires")
+        try:
+            expires: Optional[float] = float(raw)
+        except (TypeError, ValueError):
+            expires = None
+        return owner, expires
+
+    @staticmethod
+    def lease_expired(row: dict, now: float) -> bool:
+        """Row-level expiry check (query_all scans). A RUNNING row with no
+        parseable lease is a legacy/torn record and counts as expired."""
+        try:
+            return float(row.get("lease_expires")) < now
+        except (TypeError, ValueError):
+            return True
 
     def get_task_ids_by_status(self, status: Any) -> List[str]:
         return self.backend.get_values_by_conditions("task_id", task_status=status)

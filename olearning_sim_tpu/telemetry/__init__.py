@@ -179,6 +179,20 @@ CATALOG = {
     "ols_taskmgr_queue_depth": (
         GAUGE, "Tasks waiting in the scheduler queue", (),
     ),
+    # --------------------------------------------------------- supervisor
+    "ols_supervisor_resumes_total": (
+        COUNTER,
+        "Expired-lease RUNNING tasks re-adopted by the supervisor and "
+        "relaunched through the checkpoint resume path",
+        ("task_id",),
+    ),
+    "ols_supervisor_lease_age_seconds": (
+        HISTOGRAM,
+        "How long past expiry a reclaimed task's lease was when the "
+        "supervisor took it (recovery latency; tune the lease TTL "
+        "against this)",
+        ("task_id",), _IO_BUCKETS,
+    ),
     # --------------------------------------------------------- resilience
     "ols_resilience_events_total": (
         COUNTER,

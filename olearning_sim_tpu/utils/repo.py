@@ -22,9 +22,32 @@ columns the same way); typed access is the caller's concern.
 from __future__ import annotations
 
 import abc
+import contextlib
 import sqlite3
 import threading
 from typing import Any, Dict, List, Optional, Sequence
+
+
+def connect_sqlite(path: str, *, busy_timeout_s: float = 30.0,
+                   synchronous: str = "NORMAL") -> sqlite3.Connection:
+    """The one way the platform opens a sqlite control-plane DB.
+
+    Every raw ``sqlite3.connect(..., check_same_thread=False)`` call site
+    (task table, intake queue, durable deviceflow rooms) used to set its own
+    pragmas — or none, so a supervisor thread writing while a gRPC thread
+    read would hit ``database is locked``. This helper enables WAL (readers
+    never block the writer and vice versa) and a busy timeout (a second
+    writer waits instead of raising) for all of them.
+    """
+    conn = sqlite3.connect(path, check_same_thread=False,
+                           timeout=busy_timeout_s)
+    with contextlib.suppress(sqlite3.Error):
+        # ":memory:" and some read-only mounts refuse WAL; the connection is
+        # still usable, just without multi-process concurrency.
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute(f"PRAGMA synchronous={synchronous}")
+    conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_s * 1000)}")
+    return conn
 
 
 class TableRepo(abc.ABC):
@@ -59,6 +82,68 @@ class TableRepo(abc.ABC):
     # Convenience shared helpers -------------------------------------------------
     def has_item(self, identify_name: str, identify_value: Any) -> bool:
         return len(self.get_values_by_conditions(identify_name, **{identify_name: identify_value})) > 0
+
+    @staticmethod
+    def _lease_claimable(owner: Any, expires: Any, owner_value: str,
+                         now: float, steal: bool) -> bool:
+        """Shared claim predicate. With ``steal`` a row is claimable when it
+        is already ours, unowned, or its lease has expired (a set owner with
+        no parseable expiry is a legacy/torn row — treated as expired).
+        Without ``steal`` (renewal) ONLY the current owner qualifies — a
+        renewal that succeeded on an unowned row would let a fenced/stale
+        process silently re-adopt a task that was already finalized."""
+        if owner == owner_value:
+            return True
+        if not steal:
+            return False
+        if owner in (None, ""):
+            return True
+        try:
+            return float(expires) < now
+        except (TypeError, ValueError):
+            return True
+
+    def claim_row(self, identify_name: str, identify_value: Any,
+                  owner_item: str, owner_value: str, expires_item: str,
+                  new_expires: float, now: float, steal: bool = True) -> bool:
+        """Atomic conditional ownership write (the lease CAS): set
+        ``owner_item = owner_value`` and ``expires_item = new_expires`` iff
+        the row is currently unowned, already owned by ``owner_value``, or
+        (when ``steal``) its lease expired before ``now``. Returns True iff
+        this caller owns the row after the call.
+
+        This base implementation is read-check-write and therefore only
+        best-effort for exotic backends; :class:`MemoryTableRepo` (process
+        lock), :class:`SqliteTableRepo`, and :class:`MySqlTableRepo`
+        (single conditional UPDATE) override it with genuinely atomic
+        versions.
+        """
+        owner = self.get_item_value(identify_name, identify_value, owner_item)
+        expires = self.get_item_value(identify_name, identify_value, expires_item)
+        if not self._lease_claimable(owner, expires, owner_value, now, steal):
+            return False
+        ok = self.set_item_value(identify_name, identify_value, owner_item,
+                                 owner_value)
+        if not ok:
+            return False
+        self.set_item_value(identify_name, identify_value, expires_item,
+                            repr(float(new_expires)))
+        return True
+
+    def release_row(self, identify_name: str, identify_value: Any,
+                    owner_item: str, owner_value: str,
+                    expires_item: str) -> bool:
+        """Conditionally drop ownership: clear ``owner_item`` and
+        ``expires_item`` iff ``owner_item == owner_value``. Like claim_row,
+        the base version is read-check-write; the concrete backends make it
+        a single atomic conditional UPDATE so a release racing a steal can
+        never wipe the new owner's live lease."""
+        owner = self.get_item_value(identify_name, identify_value, owner_item)
+        if owner != owner_value:
+            return False
+        self.set_item_value(identify_name, identify_value, owner_item, "")
+        self.set_item_value(identify_name, identify_value, expires_item, "")
+        return True
 
 
 class MemoryTableRepo(TableRepo):
@@ -120,6 +205,36 @@ class MemoryTableRepo(TableRepo):
         with self._lock:
             return [dict(r) for r in self._rows]
 
+    def claim_row(self, identify_name, identify_value, owner_item,
+                  owner_value, expires_item, new_expires, now,
+                  steal: bool = True) -> bool:
+        with self._lock:
+            for row in self._rows:
+                if row.get(identify_name) != identify_value:
+                    continue
+                if not self._lease_claimable(
+                    row.get(owner_item), row.get(expires_item),
+                    owner_value, now, steal,
+                ):
+                    return False
+                row[owner_item] = owner_value
+                row[expires_item] = repr(float(new_expires))
+                return True
+            return False
+
+    def release_row(self, identify_name, identify_value, owner_item,
+                    owner_value, expires_item) -> bool:
+        with self._lock:
+            for row in self._rows:
+                if row.get(identify_name) != identify_value:
+                    continue
+                if row.get(owner_item) != owner_value:
+                    return False
+                row[owner_item] = ""
+                row[expires_item] = ""
+                return True
+            return False
+
 
 class SqliteTableRepo(TableRepo):
     """sqlite3-backed repo; one table per instance, TEXT columns.
@@ -138,7 +253,7 @@ class SqliteTableRepo(TableRepo):
         self.table = table
         self.columns = list(columns)
         self._lock = threading.RLock()
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn = connect_sqlite(path)
         cols = ", ".join(f"{c} TEXT" for c in self.columns)
         with self._lock:
             self._conn.execute(f"CREATE TABLE IF NOT EXISTS {table} ({cols})")
@@ -226,6 +341,54 @@ class SqliteTableRepo(TableRepo):
             cur = self._conn.execute(f"SELECT {', '.join(self.columns)} FROM {self.table}")
             rows = cur.fetchall()
         return [dict(zip(self.columns, r)) for r in rows]
+
+    def _claim_sql(self, identify_name: str, owner_item: str,
+                   expires_item: str, steal: bool, ph: str = "?") -> str:
+        """One conditional UPDATE = the whole CAS: the WHERE clause encodes
+        the claim predicate (renewal: current owner ONLY; steal: owner, or
+        unowned, or expired/torn lease), so two processes racing on the
+        same file DB cannot both win (sqlite serializes writers; rowcount
+        arbitrates)."""
+        cond = f"({owner_item} = {ph}"
+        if steal:
+            cond += (f" OR {owner_item} IS NULL OR {owner_item} = ''"
+                     f" OR {expires_item} IS NULL OR {expires_item} = ''"
+                     f" OR CAST({expires_item} AS REAL) < {ph}")
+        cond += ")"
+        return (f"UPDATE {self.table} SET {owner_item} = {ph}, "
+                f"{expires_item} = {ph} WHERE {identify_name} = {ph} AND {cond}")
+
+    def claim_row(self, identify_name, identify_value, owner_item,
+                  owner_value, expires_item, new_expires, now,
+                  steal: bool = True) -> bool:
+        try:
+            sql = self._claim_sql(self._col(identify_name),
+                                  self._col(owner_item),
+                                  self._col(expires_item), steal)
+            params = [owner_value, repr(float(new_expires)), identify_value,
+                      owner_value]
+            if steal:
+                params.append(float(now))
+            with self._lock:
+                cur = self._conn.execute(sql, params)
+                self._conn.commit()
+            return cur.rowcount > 0
+        except sqlite3.Error:
+            return False
+
+    def release_row(self, identify_name, identify_value, owner_item,
+                    owner_value, expires_item) -> bool:
+        try:
+            sql = (f"UPDATE {self.table} SET {self._col(owner_item)} = '', "
+                   f"{self._col(expires_item)} = '' WHERE "
+                   f"{self._col(identify_name)} = ? AND "
+                   f"{self._col(owner_item)} = ?")
+            with self._lock:
+                cur = self._conn.execute(sql, (identify_value, owner_value))
+                self._conn.commit()
+            return cur.rowcount > 0
+        except sqlite3.Error:
+            return False
 
 
 class MySqlTableRepo(TableRepo):
@@ -382,3 +545,42 @@ class MySqlTableRepo(TableRepo):
             return [dict(zip(self.columns, r)) for r in cur.fetchall()]
         except Exception:  # noqa: BLE001
             return []
+
+    def claim_row(self, identify_name, identify_value, owner_item,
+                  owner_value, expires_item, new_expires, now,
+                  steal: bool = True) -> bool:
+        """Single conditional UPDATE (see SqliteTableRepo._claim_sql); the
+        DB serializes concurrent claimers and rowcount arbitrates.
+        DECIMAL cast: valid in MySQL and mapped to NUMERIC affinity by the
+        sqlite driver the adapter is tested against."""
+        try:
+            oi, ei = self._col(owner_item), self._col(expires_item)
+            cond = f"({oi} = {self._ph}"
+            if steal:
+                cond += (f" OR {oi} IS NULL OR {oi} = ''"
+                         f" OR {ei} IS NULL OR {ei} = ''"
+                         f" OR CAST({ei} AS DECIMAL(20,6)) < {self._ph}")
+            cond += ")"
+            sql = (f"UPDATE {self.table} SET {oi} = {self._ph}, "
+                   f"{ei} = {self._ph} WHERE "
+                   f"{self._col(identify_name)} = {self._ph} AND {cond}")
+            params = [owner_value, repr(float(new_expires)), identify_value,
+                      owner_value]
+            if steal:
+                params.append(float(now))
+            return self._execute(sql, params).rowcount > 0
+        except Exception:  # noqa: BLE001
+            return False
+
+    def release_row(self, identify_name, identify_value, owner_item,
+                    owner_value, expires_item) -> bool:
+        try:
+            sql = (f"UPDATE {self.table} SET {self._col(owner_item)} = '', "
+                   f"{self._col(expires_item)} = '' WHERE "
+                   f"{self._col(identify_name)} = {self._ph} AND "
+                   f"{self._col(owner_item)} = {self._ph}")
+            return self._execute(
+                sql, (identify_value, owner_value)
+            ).rowcount > 0
+        except Exception:  # noqa: BLE001
+            return False

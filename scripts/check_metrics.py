@@ -33,7 +33,7 @@ sys.path.insert(0, REPO)
 SUBSYSTEMS = {
     "engine", "fedcore", "checkpoint", "deviceflow", "taskmgr",
     "resilience", "storage", "parallel", "models", "services", "telemetry",
-    "perf", "phonemgr", "resourcemgr", "clustermgr",
+    "perf", "phonemgr", "resourcemgr", "clustermgr", "supervisor",
 }
 UNITS = {
     "total", "seconds", "bytes", "ratio", "info", "depth", "batches",

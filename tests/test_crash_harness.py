@@ -1,0 +1,189 @@
+"""Crash harness (tier-1 acceptance): an engine worker OS process is
+SIGKILLed mid-round; the supervisor reclaims the orphaned task off the
+shared sqlite task table and relaunches it through the checkpoint resume
+path; the final global model is bitwise identical to an uninterrupted run.
+
+The child process (``python test_crash_harness.py child <db> <ckpt> <id>``)
+plays the worker: it registers the RUNNING row with a short-TTL lease
+(mirroring ``TaskManager._submit_scheduled``), builds the engine runner
+from the same task JSON the parent later resumes from, slows each round a
+little so the kill lands mid-run, and never renews its lease — exactly a
+process that died.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TASK_ID = "crash-task"
+ROUNDS = 30
+
+
+def _task_json(ckpt_dir, with_checkpoint=True):
+    from test_taskmgr import make_task_json
+
+    js = make_task_json(TASK_ID, rounds=ROUNDS)
+    if with_checkpoint:
+        op = js["operatorflow"]["operators"][0]["logical_simulation"]
+        params = json.loads(op["operator_params"])
+        params["checkpoint"] = {"directory": ckpt_dir, "every": 1,
+                                "max_to_keep": 3}
+        op["operator_params"] = json.dumps(params)
+    return js
+
+
+def _child(db_path, ckpt_dir, task_id):
+    from test_taskmgr import make_task_json  # noqa: F401 — path sanity
+
+    from olearning_sim_tpu.engine.task_bridge import (
+        build_runner_from_taskconfig,
+    )
+    from olearning_sim_tpu.taskmgr.status import TaskStatus
+    from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+
+    js = _task_json(ckpt_dir)
+    repo = TaskTableRepo(sqlite_path=db_path)
+    repo.add_task(task_id, task_status=TaskStatus.RUNNING.name,
+                  user_id="user1")
+    repo.set_item_value(task_id, "task_params", json.dumps(js))
+    repo.set_item_value(task_id, "resource_occupied", "1")
+    repo.set_item_value(task_id, "job_id", f"job-{task_id}")
+    # Short lease, never renewed: the moment this process dies (or even
+    # just stalls past the TTL) the task is reclaimable.
+    repo.claim_lease(task_id, f"worker:{os.getpid()}", ttl_s=1.0)
+    runner = build_runner_from_taskconfig(json.dumps(js), task_repo=repo)
+    orig = runner._execute_round
+
+    def slowed(round_idx, attempt=0):
+        time.sleep(0.15)  # widen the kill window; sleep changes no math
+        return orig(round_idx, attempt)
+
+    runner._execute_round = slowed
+    print(f"READY {os.getpid()}", flush=True)
+    runner.run()
+    print("DONE", flush=True)
+
+
+def test_sigkill_mid_round_supervisor_resumes_bitwise(tmp_path):
+    from test_taskmgr import wait_for
+
+    from olearning_sim_tpu.engine.task_bridge import (
+        build_runner_from_taskconfig,
+    )
+    from olearning_sim_tpu.resilience import (
+        LEASE_EXPIRED,
+        TASK_RESUMED,
+        ResilienceLog,
+    )
+    from olearning_sim_tpu.supervisor import TaskSupervisor
+    from olearning_sim_tpu.taskmgr.status import TaskStatus
+    from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+
+    db = str(tmp_path / "tasks.db")
+    ckpt_dir = str(tmp_path / "ck")
+    stderr_path = tmp_path / "child.stderr"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO_ROOT + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+
+    def child_stderr():
+        try:
+            return stderr_path.read_text()[-4000:]
+        except OSError:
+            return "<no stderr captured>"
+
+    with open(stderr_path, "w") as stderr_file:
+        proc = subprocess.Popen(
+            [sys.executable, __file__, "child", db, ckpt_dir, TASK_ID],
+            env=env, stdout=subprocess.PIPE, stderr=stderr_file, text=True,
+        )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("READY"), \
+            f"worker never came up (got {line!r}); stderr:\n{child_stderr()}"
+        repo = TaskTableRepo(sqlite_path=db)
+        manifest_dir = os.path.join(ckpt_dir, "manifests")
+
+        def committed_steps():
+            try:
+                return [int(n[len("step-"):-len(".json")])
+                        for n in os.listdir(manifest_dir)
+                        if n.startswith("step-") and n.endswith(".json")]
+            except (OSError, ValueError):
+                return []
+
+        def progressed():
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "worker exited before the kill landed — widen the "
+                    f"round sleep or raise ROUNDS; stderr:\n{child_stderr()}"
+                )
+            # Gate the kill on the COMMIT POINT (a manifest for round >= 2),
+            # not on logical_round: progress rows land before the async
+            # orbax flush, and killing in that window would leave nothing
+            # durable to resume from beyond round 0.
+            return any(s >= 2 for s in committed_steps())
+
+        assert wait_for(progressed, timeout=240), "worker made no progress"
+        os.kill(proc.pid, signal.SIGKILL)  # mid-round, no cleanup of any kind
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # The table still says RUNNING — the worker had no chance to say
+    # anything else — and at least round 2's checkpoint durably committed.
+    assert repo.get_item_value(TASK_ID, "task_status") == \
+        TaskStatus.RUNNING.name
+    committed = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+    assert committed and max(committed) >= 2
+
+    # Supervision: the expired lease is reclaimed and the task relaunched
+    # through the checkpoint resume path, in THIS process.
+    log = ResilienceLog()
+    time.sleep(1.1)  # let the 1s worker lease lapse fully
+    sup = TaskSupervisor(task_repo=repo, lease_ttl=30.0, backoff_base_s=0.0,
+                         log=log)
+    digest = sup.scan_once()
+    assert digest["resumed"] == [TASK_ID]
+    assert log.count(LEASE_EXPIRED, TASK_ID) == 1
+    assert log.count(TASK_RESUMED, TASK_ID) == 1
+    job_id = repo.get_item_value(TASK_ID, "job_id")
+    assert job_id == f"job-{TASK_ID}~s1"
+    assert wait_for(
+        lambda: sup.launcher.get_job_status(job_id) == TaskStatus.SUCCEEDED,
+        timeout=240,
+    ), sup.launcher.get_job(job_id) and sup.launcher.get_job(job_id).error
+    assert sup.scan_once()["finalized"] == [TASK_ID]
+    assert repo.get_item_value(TASK_ID, "task_status") == \
+        TaskStatus.SUCCEEDED.name
+    resumed = sup.launcher.get_job(job_id).runner
+    # The resumed run completed every round: restored rounds + replayed
+    # rounds stitch into one contiguous history.
+    assert [h["round"] for h in resumed.history] == list(range(ROUNDS))
+
+    # Headline: bitwise equality with an uninterrupted run of the same
+    # task (same task_id => same RNG streams; no checkpointing needed).
+    baseline = build_runner_from_taskconfig(
+        json.dumps(_task_json(ckpt_dir, with_checkpoint=False)),
+        task_repo=TaskTableRepo(),
+    )
+    baseline.run()
+    got = jax.tree.leaves(jax.device_get(resumed.states["data_0"].params))
+    want = jax.tree.leaves(jax.device_get(baseline.states["data_0"].params))
+    assert len(got) == len(want)
+    for x, y in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 4 and sys.argv[1] == "child":
+        _child(sys.argv[2], sys.argv[3], sys.argv[4])

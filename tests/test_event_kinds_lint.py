@@ -1,0 +1,35 @@
+"""Resilience event-kind lint (tier-1): every kind emitted in the package
+is declared in ``resilience/events.py`` and documented in
+docs/resilience.md — ``scripts/check_event_kinds.py`` wired into the
+suite, mirroring test_injection_lint.py."""
+
+import sys
+from pathlib import Path
+
+SCRIPTS = Path(__file__).resolve().parent.parent / "scripts"
+sys.path.insert(0, str(SCRIPTS))
+
+
+def test_event_kinds_declared_and_documented():
+    import check_event_kinds
+
+    problems = check_event_kinds.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_event_kind_collector_finds_known_kinds():
+    import check_event_kinds
+
+    decls = check_event_kinds.declared_kinds()
+    # Spot-check long-standing and freshly added vocabulary.
+    for const, value in (
+        ("RETRY", "retry"),
+        ("ROLLBACK", "rollback"),
+        ("LEASE_EXPIRED", "lease_expired"),
+        ("TASK_RESUMED", "task_resumed"),
+        ("CRASH_LOOP", "crash_loop"),
+    ):
+        assert decls.get(const) == value, f"collector lost {const}"
+    emitted = check_event_kinds.emitted_kinds()
+    assert any(const == "TASK_RESUMED" for const, _ in emitted), \
+        "collector lost the supervisor's TASK_RESUMED emission"
