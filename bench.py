@@ -50,7 +50,8 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
                input_shape=None, text=False, num_classes=10, batch=32,
                local_steps=10, block=256, timed_rounds=3, unroll=1,
                block_unroll=1, carry=None, model_overrides=None,
-               vocab_size=None, seq_len=None, deadline_frac=None):
+               vocab_size=None, seq_len=None, deadline_frac=None,
+               attack_frac=None, defense=None):
     """One benchmark family: build, warm, time. Returns the record dict.
 
     ``carry``: "bf16" runs local SGD with a bfloat16 params carry (halves
@@ -61,6 +62,13 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
     seeded synthetic completion-time array placed so that roughly this
     fraction of clients straggle past the deadline — measures the in-jit
     deadline masking overhead against the same family without it.
+
+    ``attack_frac`` / ``defense``: run the adversarial-defense round-step
+    variant — ``attack_frac`` of the clients ship sign-flipped deltas
+    (seeded, in-jit) and ``defense`` (a DefenseConfig.from_dict dict)
+    enables clipping / robust aggregation / anomaly scoring. The delta vs
+    the same family without them is the in-jit robust-aggregation
+    overhead.
     """
     import jax.numpy as jnp
 
@@ -100,6 +108,26 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
             completion_time=global_put(comp, plan.client_sharding()),
             deadline=float(np.quantile(comp, 1.0 - float(deadline_frac))),
         )
+    if attack_frac is not None:
+        # Seeded sign-flip attack on ~attack_frac of the REAL population
+        # (padding clients have zero weight — drawing them would dilute
+        # the nominal fraction), applied to the deltas inside the
+        # compiled program.
+        from olearning_sim_tpu.parallel.mesh import global_put
+
+        real = ds.num_real_clients
+        scale = np.ones(ds.num_clients, np.float32)
+        k = max(1, int(float(attack_frac) * real))
+        idx = np.random.default_rng(1).choice(real, size=k, replace=False)
+        scale[idx] = -1.0
+        pace_kwargs["attack_scale"] = global_put(
+            scale, plan.client_sharding()
+        )
+    if defense is not None:
+        from olearning_sim_tpu.engine.defense import DefenseConfig
+
+        defense = DefenseConfig.from_dict(dict(defense))
+        pace_kwargs["defense"] = defense
 
     def step():
         nonlocal state, personal
@@ -144,6 +172,11 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
         **({"deadline_frac": float(deadline_frac),
             "stragglers": int(metrics.stragglers)}
            if deadline_frac is not None else {}),
+        **({"attack_frac": float(attack_frac)}
+           if attack_frac is not None else {}),
+        **({"defense": defense.aggregator,
+            "clipped": int(metrics.clipped)}
+           if defense is not None else {}),
     }
 
 
@@ -538,6 +571,17 @@ SUITE_FAMILIES = [
          algorithm=("fedavg", dict(local_lr=0.05)), num_clients=1000,
          n_local=20, input_shape=(28, 28, 1), block=64, unroll=10, batch=32,
          local_steps=10, timed_rounds=2, deadline_frac=0.2),
+    # Adversarial-defense variant of the mlp family: 10% of clients ship
+    # sign-flipped deltas; the defense clips, aggregates by coordinate-wise
+    # trimmed mean, and scores anomalies in-jit. The delta vs
+    # fedavg_mnist_mlp_1k is the robust-aggregation overhead (the gather +
+    # per-coordinate sorts — the one defense path that is NOT free).
+    dict(name="fedavg_mnist_mlp_1k_defense", model="mlp2",
+         algorithm=("fedavg", dict(local_lr=0.05)), num_clients=1000,
+         n_local=20, input_shape=(28, 28, 1), block=64, unroll=10, batch=32,
+         local_steps=10, timed_rounds=2, attack_frac=0.1,
+         defense=dict(clip_norm=10.0, aggregator="trimmed_mean",
+                      trim_fraction=0.15, anomaly_threshold=4.0)),
     # resnet/distilbert/vit block+unroll follow the headline's measured
     # lesson (small client blocks + full step unroll beat big blocks for
     # conv/attention models; the round-2 sweep of these exact families was

@@ -15,6 +15,7 @@ from olearning_sim_tpu.engine.algorithms import (
     from_config,
     scaffold,
 )
+from olearning_sim_tpu.engine.defense import DefenseConfig
 from olearning_sim_tpu.engine.fedcore import (
     ControlState,
     FedCore,
@@ -36,6 +37,7 @@ __all__ = [
     "DeadlineConfig",
     "DeadlineController",
     "DeadlineMissError",
+    "DefenseConfig",
     "FedCore",
     "PersonalState",
     "RoundMetrics",

@@ -80,6 +80,13 @@ class RoundMetrics(struct.PyTreeNode):
     # deadline-off path). Distinct from drops: a straggler's update exists
     # but arrived too late to aggregate.
     stragglers: jnp.ndarray = struct.field(default_factory=lambda: jnp.float32(0.0))
+    # Adversarial-client defense (engine/defense.py). ``anomaly_score``:
+    # per-client [C] Krum-style distance-to-median scores (sharded over dp)
+    # when scoring is enabled, scalar 0 otherwise — the runner's
+    # quarantine feedback signal. ``clipped``: participants whose delta
+    # L2 norm was clipped this round (0 on the defense-off path).
+    anomaly_score: jnp.ndarray = struct.field(default_factory=lambda: jnp.float32(0.0))
+    clipped: jnp.ndarray = struct.field(default_factory=lambda: jnp.float32(0.0))
 
 
 class PersonalState(struct.PyTreeNode):
@@ -242,10 +249,17 @@ class FedCore:
                 "option-II refresh divides by K * local_lr)"
             )
         self._round_step = self._build_round_step()
-        # Deadline-masked variant: built on first use so tasks that never
-        # set a deadline pay no extra trace/compile. The deadline-off path
-        # above stays byte-identical to a build without the subsystem.
-        self._round_step_deadline = None
+        # Program variants keyed by (with_deadline, with_attack,
+        # defense_structure): built on first use so tasks that never set a
+        # deadline / attack / defense pay no extra trace/compile. The
+        # all-off path above stays byte-identical to a build without those
+        # subsystems. Scalar knobs (per-round deadline, attack scales,
+        # clip norm, trim fraction) are DATA within a variant — changing
+        # them across rounds never recompiles; ``trace_counts`` (bumped at
+        # trace time, never at execution) is the regression probe tests
+        # assert that on.
+        self._round_step_variants: dict = {(False, False, None): self._round_step}
+        self.trace_counts: dict = {}
         self._evaluate = self._build_evaluate()
         self._evaluate_personal = None  # built on first use
 
@@ -502,27 +516,57 @@ class FedCore:
     # program and GSPMD inserts the tensor-parallel collectives. Models
     # without specs (all-P() trees) are replicated over mp — correct but
     # redundant; the transformer families shard (parallel/tp.py).
-    def _build_round_step(self, with_deadline: bool = False):
+    def _build_round_step(self, with_deadline: bool = False,
+                          with_attack: bool = False, defense=None):
         """``with_deadline=True`` builds the deadline-masked variant: two
         extra inputs — ``completion_time`` [C] (simulated seconds, sharded
         like the clients) and a replicated ``deadline`` scalar — turn
         ``completion_time > deadline`` into zero aggregation weight with
         pure ``lax`` masking (no host round-trip), and the late
-        participants are counted as ``metrics.stragglers``. The default
-        variant is byte-identical to the pre-deadline program."""
+        participants are counted as ``metrics.stragglers``.
+
+        ``with_attack=True`` adds a per-client ``attack_scale`` [C] input
+        multiplied into each client's delta after local training — the
+        in-program half of the ``runner.attack_clients`` injection point
+        (sign_flip = -1, scale = factor, benign = 1; data, never a
+        recompile).
+
+        ``defense`` (a :class:`~olearning_sim_tpu.engine.defense.
+        DefenseConfig`) adds two replicated data inputs — ``clip_norm`` and
+        ``trim_fraction`` — and composes per-client L2 delta clipping,
+        optional coordinate-wise trimmed-mean/median aggregation, and
+        Krum-style per-client anomaly scores (``metrics.anomaly_score``)
+        into the same compiled program (pure ``lax``; see engine/defense.py
+        for the memory trade-off of the gathering aggregators).
+
+        The default variant is byte-identical to the pre-deadline,
+        pre-defense program."""
         plan = self.plan
         cfg = self.config
         alg = self.algorithm
         mesh = plan.mesh
         personalized = alg.personalized
         controlled = alg.control_variates
+        defense_gather = defense is not None and defense.gathers_deltas
+        defense_score = defense is not None and defense.score_enabled
+        aggregator = defense.aggregator if defense is not None else "mean"
+        trace_key = (with_deadline, with_attack,
+                     defense.structure_key if defense is not None else None)
 
         def shard_body(params, opt_state, round_idx, base_key,
                        x, y, num_samples, num_steps, uid, weight, vparams,
-                       server_c, true_n, *pace):
+                       server_c, true_n, *extras):
+            # Host-side effect that runs at TRACE time only: the
+            # no-recompile regression probe (tests assert this count stays
+            # flat while per-round data knobs change).
+            self.trace_counts[trace_key] = \
+                self.trace_counts.get(trace_key, 0) + 1
+            extras = list(extras)
             stragglers = jnp.float32(0.0)
+            attack_scale = clip_norm = trim_fraction = None
             if with_deadline:
-                completion_time, deadline = pace
+                completion_time, deadline = extras[0], extras[1]
+                del extras[:2]
                 # A participating client whose simulated completion misses
                 # the round deadline contributes nothing. where(late, 0, w)
                 # selects the untouched weight bitwise for on-time clients,
@@ -535,6 +579,11 @@ class FedCore:
                     "dp",
                 )
                 weight = jnp.where(late, jnp.zeros_like(weight), weight)
+            if with_attack:
+                attack_scale = extras.pop(0)
+            if defense is not None:
+                clip_norm, trim_fraction = extras[0], extras[1]
+                del extras[:2]
             c_local = x.shape[0]
             if c_local % cfg.block_clients != 0:
                 raise ValueError(
@@ -550,7 +599,8 @@ class FedCore:
             xs = (blocked(x), blocked(y), blocked(num_samples),
                   blocked(num_steps), blocked(uid), blocked(weight),
                   jax.tree.map(blocked, vparams)
-                  if (personalized or controlled) else None)
+                  if (personalized or controlled) else None,
+                  blocked(attack_scale) if with_attack else None)
 
             zero_delta = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params
@@ -558,13 +608,21 @@ class FedCore:
             init = (zero_delta, jnp.float32(0.0), jnp.float32(0.0),
                     jnp.float32(0.0), jnp.float32(0.0),
                     zero_delta if controlled else jnp.float32(0.0))
+            if defense is not None:
+                # Extra accumulator: participants whose delta was clipped.
+                init = init + (jnp.float32(0.0),)
             # The carry accumulates device-varying values (per-shard client
             # sums), so its initial value must be typed as varying over dp.
             init = _to_varying(init, "dp")
 
             def block_step(carry, inp):
-                sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc = carry
-                bx, by, bns, bst, buid, bw, bvp = inp
+                if defense is not None:
+                    (sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc,
+                     n_clip) = carry
+                else:
+                    sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc = carry
+                    n_clip = None
+                bx, by, bns, bst, buid, bw, bvp, batk = inp
                 if controlled:
                     deltas, losses, dcis = jax.vmap(
                         self._local_train,
@@ -576,6 +634,18 @@ class FedCore:
                         self._local_train,
                         in_axes=(None, 0, 0, 0, 0, 0, None, None),
                     )(params, bx, by, bns, bst, buid, base_key, round_idx)
+                if with_attack:
+                    # Byzantine update attack: the client "trains honestly"
+                    # but ships a transformed delta (sign_flip = -1,
+                    # scale = factor). A benign scale of exactly 1.0 is a
+                    # bitwise no-op, so an all-ones attack vector reproduces
+                    # the attack-free program's outputs.
+                    deltas = jax.tree.map(
+                        lambda d: d * batk.astype(d.dtype).reshape(
+                            (-1,) + (1,) * (d.ndim - 1)
+                        ),
+                        deltas,
+                    )
                 # Resilience gate: a client whose local training diverged
                 # (non-finite loss or any non-finite delta leaf) contributes
                 # NOTHING to the aggregate. Without this, one NaN client
@@ -595,12 +665,52 @@ class FedCore:
                     )
 
                 bw_eff = jnp.where(ok, bw, 0.0)
-                sum_delta = jax.tree.map(
-                    lambda s, d: s + jnp.tensordot(
-                        bw_eff, gate(d.astype(jnp.float32)), axes=(0, 0)
-                    ),
-                    sum_delta, deltas,
-                )
+                defense_ys = None
+                if defense is not None:
+                    # Per-client L2 norm clip: a delta beyond the clip
+                    # sphere is rescaled onto it. where-select (not a
+                    # multiply-by-1) so an unclipped delta — and the whole
+                    # program under clip_norm=inf — stays bitwise
+                    # untouched.
+                    d32 = jax.tree.map(
+                        lambda d: gate(d.astype(jnp.float32)), deltas
+                    )
+                    norm2 = functools.reduce(
+                        jnp.add,
+                        [jnp.square(l.reshape(l.shape[0], -1)).sum(axis=1)
+                         for l in jax.tree.leaves(d32)],
+                    )
+                    too_big = norm2 > clip_norm * clip_norm
+                    scale = jnp.where(
+                        too_big, clip_norm / jnp.sqrt(norm2), 1.0
+                    )
+                    d32 = jax.tree.map(
+                        lambda d: jnp.where(
+                            too_big.reshape((-1,) + (1,) * (d.ndim - 1)),
+                            d * scale.reshape((-1,) + (1,) * (d.ndim - 1)),
+                            d,
+                        ),
+                        d32,
+                    )
+                    n_clip = n_clip + jnp.logical_and(
+                        bw_eff > 0, too_big
+                    ).sum().astype(jnp.float32)
+                    sum_delta = jax.tree.map(
+                        lambda s, d: s + jnp.tensordot(bw_eff, d, axes=(0, 0)),
+                        sum_delta, d32,
+                    )
+                    if defense_gather:
+                        # The gathering aggregators/scores need every
+                        # client's (gated, clipped) delta — emitted from the
+                        # scan and all-gathered after it.
+                        defense_ys = (d32, bw_eff)
+                else:
+                    sum_delta = jax.tree.map(
+                        lambda s, d: s + jnp.tensordot(
+                            bw_eff, gate(d.astype(jnp.float32)), axes=(0, 0)
+                        ),
+                        sum_delta, deltas,
+                    )
                 sum_w = sum_w + bw_eff.sum()
                 sum_loss = sum_loss + jnp.where(ok, bw * losses, 0.0).sum()
                 count = count + (bw_eff > 0).sum().astype(jnp.float32)
@@ -654,12 +764,21 @@ class FedCore:
                     ys = (losses, new_vp)
                 else:
                     ys = (losses, None)
-                return (sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc), ys
+                new_carry = (sum_delta, sum_w, sum_loss, count, sum_ploss,
+                             sum_dc)
+                if defense is not None:
+                    new_carry = new_carry + (n_clip,)
+                return new_carry, ys + (defense_ys,)
 
-            carry, (block_losses, new_vparams) = jax.lax.scan(
+            carry, (block_losses, new_vparams, defense_out) = jax.lax.scan(
                 block_step, init, xs, unroll=min(cfg.block_unroll, nb)
             )
-            sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc = carry
+            if defense is not None:
+                (sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc,
+                 n_clip) = carry
+            else:
+                sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc = carry
+                n_clip = jnp.float32(0.0)
             client_loss = block_losses.reshape((c_local,))
             if personalized or controlled:
                 new_vparams = jax.tree.map(
@@ -673,9 +792,60 @@ class FedCore:
             sum_loss = jax.lax.psum(sum_loss, "dp")
             count = jax.lax.psum(count, "dp")
             sum_ploss = jax.lax.psum(sum_ploss, "dp")
+            if defense is not None:
+                n_clip = jax.lax.psum(n_clip, "dp")
 
             denom = jnp.maximum(sum_w, 1e-8)
             mean_delta = jax.tree.map(lambda s: s / denom, sum_delta)
+            anomaly_score = jnp.float32(0.0)
+            if defense_gather:
+                # The robust aggregators / anomaly scores need the full
+                # per-client delta matrix: un-block this shard's clipped
+                # deltas and all-gather them over dp (every device then
+                # holds all C clients — see engine/defense.py for the
+                # memory trade-off).
+                from olearning_sim_tpu.engine import defense as defense_mod
+
+                d_pc, w_pc = defense_out
+                d_all = jax.tree.map(
+                    lambda a: jax.lax.all_gather(
+                        a.reshape((c_local,) + a.shape[2:]), "dp", tiled=True
+                    ),
+                    d_pc,
+                )
+                w_all = jax.lax.all_gather(
+                    w_pc.reshape((c_local,)), "dp", tiled=True
+                )
+                participants = w_all > 0
+                center = None
+                if aggregator in ("trimmed_mean", "median"):
+                    agg = defense_mod.robust_aggregate(
+                        d_all, participants, aggregator, trim_fraction
+                    )
+                    if aggregator == "median":
+                        center = agg
+                    # Identical on every device (deterministic ops over
+                    # all-gathered data); pmax re-types the value as
+                    # axis-invariant without changing a single bit so it
+                    # can exit through the replicated out_spec.
+                    mean_delta = jax.tree.map(
+                        lambda a: jax.lax.pmax(a, "dp"), agg
+                    )
+                if defense_score:
+                    if center is None:
+                        center = defense_mod.robust_aggregate(
+                            d_all, participants, "median", trim_fraction
+                        )
+                    scores = defense_mod.distance_scores(
+                        d_all, center, participants
+                    )
+                    # Each shard exits with its own clients' scores (same
+                    # layout as client_loss).
+                    anomaly_score = jax.lax.dynamic_slice(
+                        scores,
+                        (jax.lax.axis_index("dp") * c_local,),
+                        (c_local,),
+                    )
             # Server optimizer consumes the negative mean delta as a
             # pseudo-gradient (FedOpt formulation).
             pseudo_grad = jax.tree.map(
@@ -705,6 +875,8 @@ class FedCore:
                 client_loss=client_loss,
                 personal_loss=sum_ploss / denom,
                 stragglers=stragglers,
+                anomaly_score=anomaly_score,
+                clipped=n_clip,
             )
             return (new_params, new_opt_state, round_idx + 1, metrics,
                     new_vparams, new_server_c)
@@ -714,9 +886,14 @@ class FedCore:
         metrics_specs = RoundMetrics(
             mean_loss=rep, weight_sum=rep, clients_trained=rep, client_loss=cl,
             personal_loss=rep, stragglers=rep,
+            anomaly_score=cl if defense_score else rep, clipped=rep,
         )
         # completion_time is sharded like the clients; deadline replicated.
         pace_specs = (cl, rep) if with_deadline else ()
+        # attack_scale sharded like the clients; defense scalars replicated.
+        attack_specs = (cl,) if with_attack else ()
+        defense_specs = (rep, rep) if defense is not None else ()
+        extra_specs = pace_specs + attack_specs + defense_specs
 
         def make_shard_fn(vp_tree, sc_tree=None):
             vp_spec = jax.tree.map(lambda _: cl, vp_tree)
@@ -728,7 +905,7 @@ class FedCore:
                 shard_body,
                 mesh=mesh,
                 in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl,
-                          vp_spec, sc_spec, rep) + pace_specs,
+                          vp_spec, sc_spec, rep) + extra_specs,
                 out_specs=(rep, rep, rep, metrics_specs, vp_spec, sc_spec),
                 axis_names=frozenset({"dp"}),
             )
@@ -737,7 +914,7 @@ class FedCore:
             @functools.partial(jax.jit, donate_argnums=(0, 1))
             def round_step(state: ServerState, control: ControlState,
                            x, y, num_samples, num_steps, uid, weight, true_n,
-                           *pace):
+                           *extras):
                 (new_params, new_opt_state, new_round, metrics, new_ci,
                  new_sc) = make_shard_fn(
                     control.client_controls, control.server_control
@@ -745,7 +922,7 @@ class FedCore:
                     state.params, state.opt_state, state.round_idx,
                     state.base_key, x, y, num_samples, num_steps, uid,
                     weight, control.client_controls, control.server_control,
-                    true_n, *pace,
+                    true_n, *extras,
                 )
                 return (
                     ServerState(
@@ -760,13 +937,14 @@ class FedCore:
         elif personalized:
             @functools.partial(jax.jit, donate_argnums=(0, 1))
             def round_step(state: ServerState, personal: PersonalState,
-                           x, y, num_samples, num_steps, uid, weight, *pace):
+                           x, y, num_samples, num_steps, uid, weight,
+                           *extras):
                 new_params, new_opt_state, new_round, metrics, new_vp, _ = (
                     make_shard_fn(personal.params)(
                         state.params, state.opt_state, state.round_idx,
                         state.base_key, x, y, num_samples, num_steps, uid,
                         weight, personal.params, None, jnp.float32(0.0),
-                        *pace,
+                        *extras,
                     )
                 )
                 return (
@@ -784,11 +962,11 @@ class FedCore:
 
             @functools.partial(jax.jit, donate_argnums=(0,))
             def round_step(state: ServerState, x, y, num_samples, num_steps,
-                           uid, weight, *pace):
+                           uid, weight, *extras):
                 new_params, new_opt_state, new_round, metrics, _, _ = shard_fn(
                     state.params, state.opt_state, state.round_idx, state.base_key,
                     x, y, num_samples, num_steps, uid, weight, None, None,
-                    jnp.float32(0.0), *pace,
+                    jnp.float32(0.0), *extras,
                 )
                 return (
                     ServerState(
@@ -863,6 +1041,8 @@ class FedCore:
         control: Optional[ControlState] = None,
         completion_time: Optional[jax.Array] = None,
         deadline: Optional[float] = None,
+        attack_scale: Optional[jax.Array] = None,
+        defense: Optional[Any] = None,
     ):
         """Advance one FL round over the (placed, padded) population.
 
@@ -882,6 +1062,18 @@ class FedCore:
         (not compile-time constants), so per-round deadlines never
         recompile. With ``deadline=None`` the original program runs with
         the original inputs — bitwise identical to the deadline-free build.
+
+        ``attack_scale`` — optional [C] per-client multiplier applied to
+        each client's delta after local training (byzantine update attack:
+        sign_flip = -1, scale = factor, benign = 1; data, so per-round
+        attack sets never recompile).
+
+        ``defense`` — optional
+        :class:`~olearning_sim_tpu.engine.defense.DefenseConfig`: in-jit
+        L2 delta clipping, trimmed-mean / median robust aggregation, and
+        Krum-style per-client anomaly scores (``metrics.anomaly_score``).
+        Scalar knobs (clip_norm, trim_fraction) are data; the aggregator
+        choice and scoring toggle select a lazily-compiled program variant.
         """
         weight = ds.weight if participate is None else ds.weight * participate
         if num_steps is None:
@@ -889,22 +1081,46 @@ class FedCore:
                 np.full((ds.num_clients,), self.config.max_local_steps, np.int32),
                 self.plan.client_sharding(),
             )
-        fn = self._round_step
-        pace = ()
+        if defense is not None and not defense.enabled:
+            defense = None
+        if defense is not None and defense.gathers_deltas \
+                and self.algorithm.control_variates:
+            raise ValueError(
+                "robust aggregators / anomaly scoring are not supported "
+                "with control-variate algorithms (the SCAFFOLD server "
+                "control consumes the weighted mean); use clip_norm only"
+            )
+        extras = ()
         if deadline is not None:
             if completion_time is None:
                 raise ValueError(
                     "deadline given without completion_time; compute one "
                     "with olearning_sim_tpu.engine.pacing.completion_times"
                 )
-            if self._round_step_deadline is None:
-                self._round_step_deadline = self._build_round_step(
-                    with_deadline=True
-                )
-            fn = self._round_step_deadline
-            pace = (completion_time, jnp.float32(deadline))
+            extras += (completion_time, jnp.float32(deadline))
         elif completion_time is not None:
             raise ValueError("completion_time given without a deadline")
+        if attack_scale is not None:
+            extras += (attack_scale,)
+        if defense is not None:
+            clip = defense.clip_norm
+            if clip is None or not np.isfinite(clip):
+                # clip disabled: a literal inf input re-keys the jit
+                # executable cache (observed: one extra compile per
+                # finite<->inf transition), so pass a finite sentinel
+                # instead — its square overflows to f32 inf, making
+                # ``norm2 > clip*clip`` unconditionally false, which
+                # disables clipping bitwise-identically.
+                clip = 3.0e38
+            extras += (jnp.float32(clip), jnp.float32(defense.trim_fraction))
+        key = (deadline is not None, attack_scale is not None,
+               defense.structure_key if defense is not None else None)
+        fn = self._round_step_variants.get(key)
+        if fn is None:
+            fn = self._build_round_step(
+                with_deadline=key[0], with_attack=key[1], defense=defense,
+            )
+            self._round_step_variants[key] = fn
         if self.algorithm.control_variates:
             if control is None:
                 raise ValueError(
@@ -914,7 +1130,7 @@ class FedCore:
                 )
             return self._launch(
                 fn, state, control, ds.x, ds.y, ds.num_samples, num_steps,
-                ds.client_uid, weight, jnp.float32(ds.population), *pace,
+                ds.client_uid, weight, jnp.float32(ds.population), *extras,
             )
         if control is not None:
             raise ValueError(
@@ -929,7 +1145,7 @@ class FedCore:
                 )
             return self._launch(
                 fn, state, personal, ds.x, ds.y, ds.num_samples, num_steps,
-                ds.client_uid, weight, *pace,
+                ds.client_uid, weight, *extras,
             )
         if personal is not None:
             raise ValueError(
@@ -938,7 +1154,7 @@ class FedCore:
             )
         return self._launch(
             fn, state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid,
-            weight, *pace,
+            weight, *extras,
         )
 
     def _launch(self, fn, *args):

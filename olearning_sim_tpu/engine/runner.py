@@ -46,6 +46,7 @@ import numpy as np
 from olearning_sim_tpu.deviceflow.service import DeviceFlowService
 from olearning_sim_tpu.deviceflow.trace_compiler import ClientTrace, compile_trace
 from olearning_sim_tpu.engine.client_data import ClientDataset
+from olearning_sim_tpu.engine.defense import DefenseConfig
 from olearning_sim_tpu.engine.fedcore import FedCore
 from olearning_sim_tpu.engine import pacing
 from olearning_sim_tpu.engine.pacing import (
@@ -56,6 +57,7 @@ from olearning_sim_tpu.engine.pacing import (
 )
 from olearning_sim_tpu.parallel.mesh import global_put
 from olearning_sim_tpu.resilience import (
+    CLIENT_FLAGGED,
     DEADLINE_MISS,
     ROLLBACK,
     SKIP_ROUND,
@@ -140,6 +142,8 @@ class SimulationRunner:
         registry: Optional[Any] = None,
         tracer: Optional[Any] = None,
         deadline: Optional[DeadlineConfig] = None,
+        defense: Optional[DefenseConfig] = None,
+        quarantine_preseed: Optional[Dict[str, List[int]]] = None,
     ):
         """``model_io`` — a :class:`ModelUpdateExporter` realizing the
         reference's model-update-style convention (round r's global model
@@ -157,7 +161,14 @@ class SimulationRunner:
         through the failure policy as ``deadline_miss`` events, and
         adaptive pacing whose controller state rides the per-round history
         records (and therefore checkpoint/rollback). None keeps rounds
-        deadline-free, bitwise identical to the pre-deadline engine."""
+        deadline-free, bitwise identical to the pre-deadline engine.
+        ``defense`` — opt-in adversarial-client defense
+        (:class:`~olearning_sim_tpu.engine.defense.DefenseConfig`): in-jit
+        delta clipping / robust aggregation plus the anomaly→quarantine
+        feedback loop; None keeps aggregation bitwise identical to the
+        pre-defense engine. ``quarantine_preseed`` — map of population name
+        → known-bad client ids blocklisted from round 0 (engine params
+        ``{"quarantine": {"preseed": ...}}``)."""
         self.task_id = task_id
         self.core = core
         self.populations = populations
@@ -197,6 +208,11 @@ class SimulationRunner:
         self.resilience = resilience
         self._rlog = (resilience.log if resilience is not None and
                       resilience.log is not None else global_log())
+        # Adversarial-client defense (engine/defense.py): in-jit clipping /
+        # robust aggregation each train round, plus the anomaly feedback
+        # loop into the quarantine manager below.
+        self.defense = (defense if defense is not None and defense.enabled
+                        else None)
         self._quarantine: Optional[QuarantineManager] = None
         if resilience is not None and resilience.quarantine_after is not None:
             self._quarantine = QuarantineManager(
@@ -204,6 +220,49 @@ class SimulationRunner:
                 readmit_after=resilience.readmit_after,
                 log=self._rlog, task_id=task_id,
             )
+        if self._quarantine is None and (
+            quarantine_preseed
+            or (self.defense is not None and self.defense.score_enabled)
+        ):
+            # The anomaly feedback loop / operator blocklist needs a
+            # quarantine manager even when the resilience config did not
+            # configure one. With anomaly scoring the defense knobs apply;
+            # a preseed-only manager must keep pure blocklist semantics —
+            # an effectively-infinite strike budget so it never
+            # auto-quarantines clients nobody asked it to watch.
+            if self.defense is not None and self.defense.score_enabled:
+                qa, ra = (self.defense.quarantine_after,
+                          self.defense.readmit_after)
+            else:
+                qa, ra = 1 << 30, 3
+            self._quarantine = QuarantineManager(
+                quarantine_after=qa, readmit_after=ra,
+                log=self._rlog, task_id=task_id,
+            )
+        if quarantine_preseed:
+            by_name = {p.name: p.dataset for p in populations}
+            for pop, ids in quarantine_preseed.items():
+                ds = by_name.get(pop)
+                if ds is None:
+                    raise ValueError(
+                        f"quarantine.preseed names unknown population "
+                        f"{pop!r} (known: {sorted(by_name)})"
+                    )
+                bad = [c for c in ids if c >= ds.num_real_clients]
+                if bad:
+                    raise ValueError(
+                        f"quarantine.preseed[{pop!r}]: client ids {bad} out "
+                        f"of range (population has {ds.num_real_clients} "
+                        f"clients)"
+                    )
+                self._quarantine.preseed(pop, ids, ds.num_clients)
+        # Per-round attack state from the ``runner.attack_clients``
+        # injection point: population name -> {"scale": [C] or None,
+        # "clients": [...], "mode": ...}; cleared and recomputed (seeded by
+        # round) at every round begin, so rollback replays reproduce the
+        # exact attack set.
+        self._attacks: Dict[str, Dict[str, Any]] = {}
+        self._clean_y: Dict[str, np.ndarray] = {}
         # Last-good-state snapshot for the round currently executing, plus
         # per-completed-round quarantine snapshots (rollback must restore the
         # quarantine decisions the replayed rounds originally saw).
@@ -498,33 +557,60 @@ class SimulationRunner:
             if pace is not None:
                 pace_kwargs = dict(completion_time=completion_dev,
                                    deadline=pace.deadline_s)
-            if self.core.algorithm.personalized:
-                personal = self.personal_states.get(p.name)
-                if personal is None:
-                    personal = self.core.init_personal(
-                        state, p.dataset.num_clients
+            atk = self._attacks.get(p.name)
+            if atk is not None and atk["scale"] is not None:
+                # Byzantine update attack (sign_flip/scale): the per-client
+                # delta multiplier is data into the compiled program.
+                pace_kwargs["attack_scale"] = global_put(
+                    atk["scale"], self.core.plan.client_sharding()
+                )
+            if self.defense is not None:
+                pace_kwargs["defense"] = self.defense
+            clean_y_dev = None
+            if atk is not None and atk["y"] is not None:
+                # Label-flip attack, scoped to THIS train launch: only the
+                # placed label array is swapped (features and the rest of
+                # the dataset stay as-is), and the finally re-installs the
+                # original device buffer — zero re-transfer, and same-round
+                # eval operators / later rounds see clean labels.
+                clean_y_dev = p.dataset.y
+                p.dataset = dataclasses.replace(
+                    p.dataset,
+                    y=global_put(atk["y"], clean_y_dev.sharding),
+                )
+            try:
+                if self.core.algorithm.personalized:
+                    personal = self.personal_states.get(p.name)
+                    if personal is None:
+                        personal = self.core.init_personal(
+                            state, p.dataset.num_clients
+                        )
+                    state, metrics, personal = self.core.round_step(
+                        state, p.dataset, participate=participate,
+                        personal=personal, num_steps=num_steps, **pace_kwargs,
                     )
-                state, metrics, personal = self.core.round_step(
-                    state, p.dataset, participate=participate,
-                    personal=personal, num_steps=num_steps, **pace_kwargs,
-                )
-                self.personal_states[p.name] = personal
-            elif self.core.algorithm.control_variates:
-                control = self.control_states.get(p.name)
-                if control is None:
-                    control = self.core.init_control(
-                        state, p.dataset.num_clients
+                    self.personal_states[p.name] = personal
+                elif self.core.algorithm.control_variates:
+                    control = self.control_states.get(p.name)
+                    if control is None:
+                        control = self.core.init_control(
+                            state, p.dataset.num_clients
+                        )
+                    state, metrics, control = self.core.round_step(
+                        state, p.dataset, participate=participate,
+                        control=control, num_steps=num_steps, **pace_kwargs,
                     )
-                state, metrics, control = self.core.round_step(
-                    state, p.dataset, participate=participate,
-                    control=control, num_steps=num_steps, **pace_kwargs,
-                )
-                self.control_states[p.name] = control
-            else:
-                state, metrics = self.core.round_step(
-                    state, p.dataset, participate=participate,
-                    num_steps=num_steps, **pace_kwargs,
-                )
+                    self.control_states[p.name] = control
+                else:
+                    state, metrics = self.core.round_step(
+                        state, p.dataset, participate=participate,
+                        num_steps=num_steps, **pace_kwargs,
+                    )
+            finally:
+                if clean_y_dev is not None:
+                    p.dataset = dataclasses.replace(
+                        p.dataset, y=clean_y_dev
+                    )
             self.states[p.name] = state
         with self._phase(operator.name, "host_transfer", round_idx):
             # The device_get is the host sync point: "train" above measures
@@ -541,18 +627,71 @@ class SimulationRunner:
                 time.perf_counter() - t_step0
             )
         ok = np.isfinite(client_loss)
+        flagged = None
+        clipped = 0
+        if self.defense is not None:
+            clipped = int(metrics.clipped)
+            if clipped:
+                instrument("ols_engine_clipped_total", self.registry).labels(
+                    task_id=self.task_id
+                ).inc(clipped)
+            if self.defense.score_enabled:
+                # Anomaly feedback loop: per-client Krum-style scores flow
+                # out of the jit; a participant whose score exceeds
+                # threshold x median(score) is flagged and accrues a
+                # quarantine strike below. The median normalization makes
+                # the threshold model- and scale-free.
+                scores = np.asarray(
+                    jax.device_get(metrics.anomaly_score)
+                )[:real]
+                # scores > 0 aligns the host mask with the program's own
+                # participant set: a selected-but-deadline-late client has
+                # its weight zeroed in-program and scores exactly 0 — it
+                # must not pollute the ratio histogram (nor be flagged for
+                # an update that was never aggregated).
+                part = (mask[:real] > 0) & ok[:real] & (scores > 0)
+                vals = scores[part]
+                med = float(np.median(vals)) if vals.size else 0.0
+                if med > 0:
+                    instrument(
+                        "ols_engine_anomaly_ratio", self.registry
+                    ).labels(task_id=self.task_id).observe_many(
+                        scores[part] / med
+                    )
+                    flagged = np.zeros(real, bool)
+                    flagged[part] = (
+                        scores[part] > self.defense.anomaly_threshold * med
+                    )
+                    ids = np.nonzero(flagged)[0]
+                    if len(ids):
+                        self._rlog.record(
+                            CLIENT_FLAGGED, point="runner.defense",
+                            task_id=self.task_id, round_idx=round_idx,
+                            population=p.name,
+                            clients=[int(i) for i in ids[:64]],
+                            num_clients=int(len(ids)),
+                            threshold=float(self.defense.anomaly_threshold),
+                            median_score=med,
+                        )
         if self._quarantine is not None:
             # Strikes accrue only for clients that actually participated and
-            # came back non-finite; quarantine countdowns advance once per
-            # train operator. Quarantined clients are then reported failed in
+            # came back non-finite (or anomaly-flagged by the defense
+            # layer); quarantine countdowns advance once per train
+            # operator. Quarantined clients are then reported failed in
             # the per-class accounting — the same way the reference reports
             # dead phones.
             self._quarantine.observe(
-                p.name, round_idx, mask[:real] > 0, ok[:real]
+                p.name, round_idx, mask[:real] > 0, ok[:real],
+                flagged=flagged,
             )
             for ci in self._quarantine.quarantined(p.name):
                 if ci < len(ok):
                     ok[ci] = False
+            instrument(
+                "ols_engine_quarantined_clients", self.registry
+            ).labels(task_id=self.task_id).set(
+                self._quarantine.num_quarantined()
+            )
         rec = {
             "mean_loss": float(metrics.mean_loss),
             "clients_trained": int(metrics.clients_trained),
@@ -561,6 +700,12 @@ class SimulationRunner:
             "sim_duration_s": trace.round_duration(),
             "ok_mask": ok,
         }
+        if self.defense is not None:
+            rec["clipped"] = clipped
+            rec["flagged"] = int(flagged.sum()) if flagged is not None else 0
+        if atk is not None:
+            rec["attacked"] = len(atk["clients"])
+            rec["attack_mode"] = atk["mode"]
         if pace is not None:
             # Stragglers of record come from the compiled program's own
             # deadline mask (metrics.stragglers) — the aggregation's truth,
@@ -747,6 +892,7 @@ class SimulationRunner:
             self.control_states = client_states
         self.history = history
         self._repace()
+        self._requarantine()
         self.logger.info(
             task_id=self.task_id, system_name="engine", module_name="runner",
             message=f"resumed from checkpoint: round {last_round} complete",
@@ -866,6 +1012,22 @@ class SimulationRunner:
         if self._pacer is not None:
             self._pacer.load_from_history(self.history)
 
+    def _requarantine(self) -> None:
+        """Rehydrate quarantine (defense) state from the history just
+        restored from checkpoint: the newest record carrying a
+        ``quarantine_state`` holds the manager as of that round's
+        completion, so a supervisor-relaunched process replays the masks —
+        and therefore the aggregation — bitwise. Without a carrying record
+        (fresh start, pre-defense checkpoints) the current state — e.g. an
+        operator preseed — is kept."""
+        if self._quarantine is None:
+            return
+        for rec in reversed(self.history):
+            st = rec.get("quarantine_state")
+            if st is not None:
+                self._quarantine.load_json(st)
+                return
+
     def _maybe_poison(self, round_idx: int) -> None:
         """``runner.poison_clients`` injection point: permanently corrupt the
         listed clients' features to NaN (a diverged/byzantine device), so
@@ -902,17 +1064,92 @@ class SimulationRunner:
             if not idx:
                 continue
             x[idx] = np.nan
-            host = ClientDataset(
-                x=x,
-                y=np.asarray(jax.device_get(ds.y)),
-                num_samples=np.asarray(jax.device_get(ds.num_samples)),
-                client_uid=np.asarray(jax.device_get(ds.client_uid)),
-                weight=np.asarray(jax.device_get(ds.weight)),
-                num_real_clients=ds.num_real_clients,
-                population_size=ds.population_size,
-            )
-            # Already padded + already in its final feature dtype.
-            p.dataset = host.place(self.core.plan, feature_dtype=None)
+            self._replace_dataset(p, x=x)
+
+    def _replace_dataset(self, p: DataPopulation, x=None, y=None) -> None:
+        """Swap feature/label arrays into a population's placed dataset
+        (already padded + already in its final feature dtype)."""
+        ds = p.dataset
+        host = ClientDataset(
+            x=np.array(jax.device_get(ds.x)) if x is None else x,
+            y=np.asarray(jax.device_get(ds.y)) if y is None else y,
+            num_samples=np.asarray(jax.device_get(ds.num_samples)),
+            client_uid=np.asarray(jax.device_get(ds.client_uid)),
+            weight=np.asarray(jax.device_get(ds.weight)),
+            num_real_clients=ds.num_real_clients,
+            population_size=ds.population_size,
+        )
+        p.dataset = host.place(self.core.plan, feature_dtype=None)
+
+    def _maybe_attack(self, round_idx: int) -> None:
+        """``runner.attack_clients`` injection point: seeded byzantine
+        client attacks, generalizing the NaN-only ``poison_clients`` to
+        *finite* adversarial behavior the aggregation gate cannot catch —
+        the workload the defense layer exists for.
+
+        Spec payload: ``{"mode": "sign_flip"|"scale"|"label_flip",
+        "clients": [...]?, "fraction": 0.1?, "factor": ...?}``; scope to one
+        population with the spec's ``match`` filter (the context is the
+        population name). Without an explicit ``clients`` list, a
+        ``fraction`` of the population is drawn seeded by
+        ``(plan seed, round, population)``. The client *draw* is therefore
+        replay-exact; whether a spec fires at all follows the injector's
+        usual hit counting, so chaos plans that must replay bitwise across
+        rollbacks/resumes should scope attacks with ``rounds=[...]`` /
+        ``times=-1`` rather than hit-count-limited specs (consumed firings
+        do not rewind). ``sign_flip`` / ``scale`` transform
+        the client's *update* inside the compiled program (delta × -1 /
+        × factor); ``label_flip`` trains that round's train steps on
+        flipped labels — the swap is scoped to the train launch itself
+        (``_run_train``), so same-round eval operators and every later
+        round see clean labels.
+        """
+        self._attacks = {}
+        inj = faults.active_injector()
+        for p in self.populations:
+            spec = faults.fire("runner.attack_clients", context=p.name,
+                               round_idx=round_idx, task_id=self.task_id)
+            if spec is None:
+                continue
+            payload = spec.payload or {}
+            mode = payload.get("mode", "sign_flip")
+            if mode not in ("sign_flip", "scale", "label_flip"):
+                raise ValueError(
+                    f"runner.attack_clients: unknown mode {mode!r} "
+                    f"(known: sign_flip, scale, label_flip)"
+                )
+            real = p.dataset.num_real_clients
+            clients = payload.get("clients")
+            if clients is None:
+                frac = float(payload.get("fraction", 0.1))
+                k = min(real, max(1, int(math.ceil(frac * real))))
+                rng = np.random.default_rng([
+                    int(inj.plan.seed) if inj is not None else 0,
+                    int(round_idx), zlib.crc32(p.name.encode()),
+                ])
+                clients = rng.choice(real, size=k, replace=False)
+            clients = sorted(int(c) for c in clients if 0 <= int(c) < real)
+            if not clients:
+                continue
+            atk: Dict[str, Any] = {"mode": mode, "clients": clients,
+                                   "scale": None, "y": None}
+            if mode in ("sign_flip", "scale"):
+                factor = float(payload.get(
+                    "factor", -1.0 if mode == "sign_flip" else 10.0
+                ))
+                scale = np.ones(p.dataset.num_clients, np.float32)
+                scale[clients] = np.float32(factor)
+                atk["scale"] = scale
+            else:  # label_flip: class c -> (num_classes - 1 - c)
+                if p.name not in self._clean_y:
+                    self._clean_y[p.name] = np.asarray(
+                        jax.device_get(p.dataset.y)
+                    ).copy()
+                y = self._clean_y[p.name].copy()
+                n_cls = int(y.max()) + 1
+                y[clients] = n_cls - 1 - y[clients]
+                atk["y"] = y
+            self._attacks[p.name] = atk
 
     def _rollback(self, round_idx: int,
                   error: BaseException) -> Optional[int]:
@@ -1131,12 +1368,19 @@ class SimulationRunner:
                 if operator.kind == "train" and hasattr(timer, "note"):
                     # Straggler/drop counts ride the RoundTiming extra so
                     # get_performance() reports them distinctly (satellite:
-                    # stragglers are not drops).
+                    # stragglers are not drops). Defense counters ride the
+                    # same channel into get_performance()["defense"].
                     timer.note(
                         stragglers=sum(rec.get("stragglers", 0)
                                        for rec in op_record.values()),
                         dropped=sum(rec.get("dropped", 0)
                                     for rec in op_record.values()),
+                        clipped=sum(rec.get("clipped", 0)
+                                    for rec in op_record.values()),
+                        flagged=sum(rec.get("flagged", 0)
+                                    for rec in op_record.values()),
+                        attacked=sum(rec.get("attacked", 0)
+                                     for rec in op_record.values()),
                     )
             if operator.kind == "train" and nc:
                 instrument(
@@ -1154,6 +1398,12 @@ class SimulationRunner:
             # records ride both the in-memory snapshot and the checkpoint
             # meta, so rollback/resume repaces deterministically (_repace).
             round_record["pacing"] = self._pacer.state_dict()
+        if self._quarantine is not None:
+            # Quarantine (defense) state after this round's observations
+            # rides the history record — and therefore checkpoint meta — so
+            # a supervisor-relaunched task replays quarantine decisions
+            # bitwise (_requarantine), not just in-process rollbacks.
+            round_record["quarantine_state"] = self._quarantine.state_json()
         self.history.append(round_record)
         # A preemption here ("runner.pre_checkpoint") dies with the round's
         # work done but not yet durable — the classic lost-round scenario the
@@ -1244,6 +1494,7 @@ class SimulationRunner:
                 faults.inject("runner.round_begin", context=str(round_idx),
                               round_idx=round_idx, task_id=self.task_id)
                 self._maybe_poison(round_idx)
+                self._maybe_attack(round_idx)
                 status = self._execute_round(
                     round_idx, flow_epoch if replaying else 0
                 )

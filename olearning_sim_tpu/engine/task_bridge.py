@@ -22,6 +22,8 @@ Arbitrary user code still works through the ``custom`` operator kind
                     "dirichlet_alpha": null, "class_sep": 2.0}, "eval_n": 1024},
       "resilience": { ...ResilienceConfig.from_dict... },    # docs/resilience.md
       "deadline":   { ...DeadlineConfig.from_dict... },      # deadline-aware rounds
+      "defense":    { ...DefenseConfig.from_dict... },       # adversarial defense
+      "quarantine": {"preseed": {"data_0": [3, 7]}},         # device blocklists
       "checkpoint": {"directory": "/ckpts/{task_id}",        # crash-safe resume
                      "every": 1, "max_to_keep": 3}
     }
@@ -417,6 +419,28 @@ def build_runner_from_taskconfig(
 
         deadline = DeadlineConfig.from_dict(params["deadline"])
 
+    # Adversarial-client defense rides the same blob (docs/resilience.md):
+    #   {"defense": {"clip_norm": 5.0, "aggregator": "trimmed_mean",
+    #                "trim_fraction": 0.1, "anomaly_threshold": 4.0}}
+    defense = None
+    if params.get("defense"):
+        from olearning_sim_tpu.engine.defense import DefenseConfig
+
+        defense = DefenseConfig.from_dict(params["defense"])
+
+    # Operator blocklists: {"quarantine": {"preseed": {"data_0": [3, 7]}}}
+    # — known-bad device ids quarantined from round 0 (validated again by
+    # the runner against the actual population sizes).
+    quarantine_preseed = None
+    if params.get("quarantine"):
+        from olearning_sim_tpu.resilience.quarantine import (
+            parse_quarantine_params,
+        )
+
+        quarantine_preseed = parse_quarantine_params(
+            params["quarantine"]
+        )["preseed"]
+
     return SimulationRunner(
         task_id=tc.taskID.taskID,
         core=core,
@@ -434,4 +458,6 @@ def build_runner_from_taskconfig(
         warm_start_path=warm_start_path,
         resilience=resilience,
         deadline=deadline,
+        defense=defense,
+        quarantine_preseed=quarantine_preseed,
     )

@@ -288,6 +288,13 @@ class PerformanceManager:
             # (stragglers) reported distinctly from trace-level drops.
             "stragglers_total": _extra_total("stragglers"),
             "dropped_total": _extra_total("dropped"),
+            # Adversarial-client defense: in-jit clip count, anomaly flags,
+            # and injected-attack totals (docs/resilience.md).
+            "defense": {
+                "clipped_total": _extra_total("clipped"),
+                "flagged_total": _extra_total("flagged"),
+                "attacked_total": _extra_total("attacked"),
+            },
             "resilience": resilience,
         }
 

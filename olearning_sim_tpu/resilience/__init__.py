@@ -17,6 +17,9 @@ TPU engine (docs/resilience.md):
 
 from olearning_sim_tpu.resilience.events import (
     CHECKPOINT_FALLBACK,
+    CLIENT_FLAGGED,
+    CLIENT_QUARANTINED,
+    CLIENT_READMITTED,
     CRASH_LOOP,
     DEADLINE_MISS,
     FAULT_INJECTED,
@@ -47,7 +50,10 @@ from olearning_sim_tpu.resilience.faults import (
     install,
 )
 from olearning_sim_tpu.resilience.policy import FailurePolicy, ResilienceConfig
-from olearning_sim_tpu.resilience.quarantine import QuarantineManager
+from olearning_sim_tpu.resilience.quarantine import (
+    QuarantineManager,
+    parse_quarantine_params,
+)
 from olearning_sim_tpu.resilience.retry import (
     NO_RETRY,
     RetryPolicy,
@@ -56,6 +62,9 @@ from olearning_sim_tpu.resilience.retry import (
 
 __all__ = [
     "CHECKPOINT_FALLBACK",
+    "CLIENT_FLAGGED",
+    "CLIENT_QUARANTINED",
+    "CLIENT_READMITTED",
     "CRASH_LOOP",
     "DEADLINE_MISS",
     "FAULT_INJECTED",
@@ -88,4 +97,5 @@ __all__ = [
     "global_log",
     "inject",
     "install",
+    "parse_quarantine_params",
 ]

@@ -44,6 +44,17 @@ TASK_RESUMED = "task_resumed"
 # ...or died so many consecutive times its resume budget ran out and it was
 # quarantined to FAILED instead of livelocking the supervisor.
 CRASH_LOOP = "crash_loop"
+# Adversarial-client defense (engine/defense.py + the runner's anomaly
+# feedback loop): a participating client's Krum-style anomaly score crossed
+# the flag threshold this round...
+CLIENT_FLAGGED = "client_flagged"
+# ...a client crossed its strike budget (non-finite updates and/or anomaly
+# flags) — or was blocklisted up-front via quarantine.preseed — and was
+# quarantined out of participation (detail carries the client ids and how
+# many tripped via anomaly flags)...
+CLIENT_QUARANTINED = "client_quarantined"
+# ...or finished its quarantine term and was re-admitted on probation.
+CLIENT_READMITTED = "client_readmitted"
 
 
 @dataclasses.dataclass

@@ -23,16 +23,67 @@ the reference reports dead phones.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional
 
 import numpy as np
 
 from olearning_sim_tpu.resilience.events import (
+    CLIENT_QUARANTINED,
+    CLIENT_READMITTED,
     QUARANTINE,
     READMIT,
     ResilienceLog,
     global_log,
 )
+
+
+def parse_quarantine_params(obj: Any) -> Dict[str, Dict[str, List[int]]]:
+    """Validate the engine-params ``quarantine`` block.
+
+    Shape: ``{"preseed": {"<population>": [client ids...]}}`` — operators
+    blocklist known-bad device ids at submit time; the runner preseeds its
+    :class:`QuarantineManager` with them. Raises ``ValueError`` /
+    ``TypeError`` with a message naming the offending key, so submit-time
+    validation surfaces a clear diagnostic instead of a server error.
+    """
+    if not isinstance(obj, dict):
+        raise TypeError(
+            f"quarantine config must be a JSON object, got "
+            f"{type(obj).__name__}"
+        )
+    unknown = sorted(set(obj) - {"preseed"})
+    if unknown:
+        raise ValueError(
+            f"unknown quarantine config keys: {unknown} (known: ['preseed'])"
+        )
+    preseed = obj.get("preseed", {})
+    if not isinstance(preseed, dict):
+        raise TypeError(
+            "quarantine.preseed must map population name -> list of client "
+            f"ids, got {type(preseed).__name__}"
+        )
+    out: Dict[str, List[int]] = {}
+    for pop, ids in preseed.items():
+        if not isinstance(pop, str) or not pop:
+            raise ValueError(
+                f"quarantine.preseed population names must be non-empty "
+                f"strings, got {pop!r}"
+            )
+        if not isinstance(ids, (list, tuple)):
+            raise TypeError(
+                f"quarantine.preseed[{pop!r}] must be a list of client ids, "
+                f"got {type(ids).__name__}"
+            )
+        cleaned: List[int] = []
+        for c in ids:
+            if isinstance(c, bool) or not isinstance(c, int) or c < 0:
+                raise ValueError(
+                    f"quarantine.preseed[{pop!r}] ids must be ints >= 0, "
+                    f"got {c!r}"
+                )
+            cleaned.append(int(c))
+        out[pop] = cleaned
+    return {"preseed": out}
 
 
 class _PopulationState:
@@ -88,14 +139,24 @@ class QuarantineManager:
     # ------------------------------------------------------------ seeding
     def preseed(self, name: str, clients: Iterable[int],
                 num_clients: int, rounds: Optional[int] = None) -> None:
-        """Quarantine ``clients`` up-front (baseline construction for chaos
-        parity tests; also useful to fence known-bad devices). ``rounds``
-        None = effectively forever."""
+        """Quarantine ``clients`` up-front (operator blocklists of known-bad
+        device ids via engine params ``quarantine.preseed``; also the
+        baseline construction for chaos parity tests). ``rounds`` None =
+        effectively forever. Recorded as a ``client_quarantined`` state
+        transition so blocklisting is visible in the resilience log."""
+        clients = [int(c) for c in clients]
         with self._lock:
             st = self._pop(name, num_clients)
             dur = np.iinfo(np.int32).max if rounds is None else int(rounds)
             for c in clients:
-                st.remaining[int(c)] = dur
+                st.remaining[c] = dur
+        if clients:
+            self.log.record(
+                CLIENT_QUARANTINED, point="runner.quarantine",
+                task_id=self.task_id, population=name,
+                clients=clients[:64], num_clients=len(clients),
+                reason="preseed",
+            )
 
     # ---------------------------------------------------------- snapshotting
     def snapshot(self) -> Dict[str, Dict[str, np.ndarray]]:
@@ -122,21 +183,69 @@ class QuarantineManager:
                 st.total_quarantines = arrays["total_quarantines"].copy()
                 self._pops[name] = st
 
+    def state_json(self) -> Dict[str, Any]:
+        """JSON-ready sparse encoding of the full state (only nonzero
+        entries). Rides the runner's per-round history records — and
+        therefore checkpoint meta — so a supervisor-relaunched task replays
+        quarantine decisions bitwise (the in-memory ``snapshot``/``restore``
+        pair only survives within one process)."""
+        def sparse(a: np.ndarray) -> Dict[str, int]:
+            # np.nonzero, not enumerate: this runs every round under the
+            # manager lock, so cost must scale with the (usually zero)
+            # nonzero entries, not the population size.
+            return {str(int(i)): int(a[i]) for i in np.nonzero(a)[0]}
+
+        with self._lock:
+            return {
+                name: {
+                    "n": int(len(st.strikes)),
+                    "strikes": sparse(st.strikes),
+                    "remaining": sparse(st.remaining),
+                    "total": sparse(st.total_quarantines),
+                }
+                for name, st in self._pops.items()
+            }
+
+    def load_json(self, obj: Dict[str, Any]) -> None:
+        """Inverse of :meth:`state_json`."""
+        with self._lock:
+            self._pops.clear()
+            for name, d in obj.items():
+                st = _PopulationState(int(d["n"]))
+                for field, arr in (("strikes", st.strikes),
+                                   ("remaining", st.remaining),
+                                   ("total", st.total_quarantines)):
+                    for k, v in (d.get(field) or {}).items():
+                        arr[int(k)] = int(v)
+                self._pops[name] = st
+
     # ----------------------------------------------------------- observing
     def observe(self, name: str, round_idx: int, participated: np.ndarray,
-                ok: np.ndarray) -> List[int]:
+                ok: np.ndarray,
+                flagged: Optional[np.ndarray] = None) -> List[int]:
         """Digest one round's per-client outcome for population ``name``.
 
         ``participated`` — bool [C]: clients the round actually released
         (trace participation x quarantine mask). ``ok`` — bool [C]: finite
-        update. Returns the newly quarantined client indices. Also advances
-        quarantine countdowns and re-admits clients whose term expired.
+        update. ``flagged`` — optional bool [C]: anomaly-flagged by the
+        defense feedback loop; a flagged client accrues a strike exactly
+        like a non-finite one (and does not clear existing strikes even if
+        finite). Returns the newly quarantined client indices. Also
+        advances quarantine countdowns and re-admits clients whose term
+        expired.
         """
         participated = np.asarray(participated, bool)
         ok = np.asarray(ok, bool)
         n = len(participated)
+        if flagged is None:
+            flagged = np.zeros(n, bool)
+        else:
+            flagged = np.asarray(flagged, bool)[:n]
+            if len(flagged) < n:
+                flagged = np.pad(flagged, (0, n - len(flagged)))
         newly: List[int] = []
         readmitted: List[int] = []
+        via_anomaly = 0
         with self._lock:
             st = self._pop(name, n)
             strikes, remaining = st.strikes, st.remaining
@@ -147,8 +256,8 @@ class QuarantineManager:
             if done.any():
                 strikes[:n][done] = self.quarantine_after - 1  # one strike left
                 readmitted = [int(i) for i in np.nonzero(done)[0]]
-            bad = participated & ~ok
-            good = participated & ok
+            bad = participated & (~ok | flagged)
+            good = participated & ok & ~flagged
             strikes[:n][good] = 0
             strikes[:n][bad] += 1
             trip = bad & (strikes[:n] >= self.quarantine_after)
@@ -157,16 +266,30 @@ class QuarantineManager:
                 st.total_quarantines[:n][trip] += 1
                 strikes[:n][trip] = 0
                 newly = [int(i) for i in np.nonzero(trip)[0]]
+                via_anomaly = int((trip & flagged).sum())
         if newly:
             self.log.record(
                 QUARANTINE, point="runner.quarantine", task_id=self.task_id,
                 round_idx=round_idx, population=name,
                 clients=newly[:64], num_clients=len(newly),
             )
+            # Per-transition event with the reason split — the quarantine
+            # feedback loop's declared state-change signal.
+            self.log.record(
+                CLIENT_QUARANTINED, point="runner.quarantine",
+                task_id=self.task_id, round_idx=round_idx, population=name,
+                clients=newly[:64], num_clients=len(newly),
+                via_anomaly=via_anomaly,
+            )
         if readmitted:
             self.log.record(
                 READMIT, point="runner.quarantine", task_id=self.task_id,
                 round_idx=round_idx, population=name,
+                clients=readmitted[:64], num_clients=len(readmitted),
+            )
+            self.log.record(
+                CLIENT_READMITTED, point="runner.quarantine",
+                task_id=self.task_id, round_idx=round_idx, population=name,
                 clients=readmitted[:64], num_clients=len(readmitted),
             )
         return newly

@@ -167,28 +167,68 @@ def validate_correctness(request) -> Tuple[bool, str]:
                         op_params = json.loads(info.operatorParams)
                     except (ValueError, TypeError):
                         raise Check(f"operator {op.name} {which} operatorParams should be a json string")
-                    if which == "logical" and isinstance(op_params, dict) \
-                            and op_params.get("deadline"):
-                        # Deadline-aware round knobs (engine/pacing.py):
-                        # reject malformed quorum/over-selection fields at
-                        # submit time, not mid-round.
+                    if which == "logical" and isinstance(op_params, dict):
+                        # Structured engine-params blocks (deadline-aware
+                        # rounds, adversarial defense, quarantine
+                        # blocklists): reject malformed knobs at submit
+                        # time, not mid-round. Wrong-shaped JSON (a string
+                        # where a dict belongs, a list for speed_profiles)
+                        # raises AttributeError/KeyError/TypeError from the
+                        # parsers — still a validation failure, not a
+                        # server error.
+                        from olearning_sim_tpu.engine.defense import (
+                            DefenseConfig,
+                        )
                         from olearning_sim_tpu.engine.pacing import (
                             DeadlineConfig,
                         )
+                        from olearning_sim_tpu.resilience.quarantine import (
+                            parse_quarantine_params,
+                        )
 
-                        try:
-                            DeadlineConfig.from_dict(op_params["deadline"])
-                        except Check:
-                            raise
-                        # Wrong-shaped JSON (a string where a dict belongs,
-                        # a list for speed_profiles) raises AttributeError/
-                        # KeyError from from_dict — still a validation
-                        # failure, not a server error.
-                        except Exception as e:  # noqa: BLE001
-                            raise Check(
-                                f"operator {op.name} deadline params "
-                                f"invalid: {type(e).__name__}: {e}"
-                            )
+                        for block, parse in (
+                            ("deadline", DeadlineConfig.from_dict),
+                            ("defense", DefenseConfig.from_dict),
+                            ("quarantine", parse_quarantine_params),
+                        ):
+                            if not op_params.get(block):
+                                continue
+                            try:
+                                parsed = parse(op_params[block])
+                            except Check:
+                                raise
+                            except Exception as e:  # noqa: BLE001
+                                raise Check(
+                                    f"operator {op.name} {block} params "
+                                    f"invalid: {type(e).__name__}: {e}"
+                                )
+                            if block == "defense" and parsed.gathers_deltas:
+                                # fedcore rejects robust aggregators /
+                                # anomaly scoring with control-variate
+                                # algorithms at round time; catch the
+                                # combination here instead.
+                                from olearning_sim_tpu.engine.algorithms import (
+                                    from_config as algorithm_from_config,
+                                )
+
+                                algo = (op_params.get("algorithm") or {})
+                                name = algo.get("name", "fedavg") \
+                                    if isinstance(algo, dict) else "fedavg"
+                                try:
+                                    control = algorithm_from_config(
+                                        name
+                                    ).control_variates
+                                except Exception:  # noqa: BLE001 — unknown
+                                    control = False  # algo fails elsewhere
+                                _req(
+                                    not control,
+                                    f"operator {op.name} defense params "
+                                    f"invalid: aggregator "
+                                    f"{parsed.aggregator!r} / anomaly "
+                                    f"scoring is not supported with the "
+                                    f"control-variate algorithm {name!r} "
+                                    f"(use clip_norm only)",
+                                )
 
         units = list(request.logicalSimulation.computationUnit.devicesUnit)
         _req(len(units) == len(set(units)), "computationUnit.devicesUnit has repeats")

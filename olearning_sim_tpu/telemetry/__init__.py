@@ -66,6 +66,9 @@ _DISPATCH_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
 # high-tier devices to many-minute stragglers.
 _SIM_TIME_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                      120.0, 300.0, 600.0, 1800.0)
+# Median-normalized anomaly scores (dimensionless ratio): benign clients
+# cluster near 1; sign-flip/scale attackers land decades above.
+_ANOMALY_BUCKETS = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
 # name -> (kind, help, label names[, buckets]). THE metric catalog of
 # record: docs/observability.md renders this table and the naming lint
@@ -117,6 +120,27 @@ CATALOG = {
         "Effective round deadline (static, adaptive-controller, or K-th "
         "arrival close) per train round",
         ("task_id",), _SIM_TIME_BUCKETS,
+    ),
+    "ols_engine_clipped_total": (
+        COUNTER,
+        "Participating clients whose delta L2 norm exceeded the defense "
+        "clip threshold and was rescaled in-jit (adversarial-client "
+        "defense)",
+        ("task_id",),
+    ),
+    "ols_engine_anomaly_ratio": (
+        HISTOGRAM,
+        "Per-participant Krum-style anomaly scores normalized by the "
+        "round's median score (benign clients cluster near 1; the flag "
+        "threshold is defense.anomaly_threshold)",
+        ("task_id",), _ANOMALY_BUCKETS,
+    ),
+    "ols_engine_quarantined_clients": (
+        GAUGE,
+        "Clients currently quarantined out of participation (strike "
+        "budget exceeded via non-finite updates, anomaly flags, or "
+        "operator preseed)",
+        ("task_id",),
     ),
     # ------------------------------------------------------------ fedcore
     "ols_fedcore_round_steps_total": (

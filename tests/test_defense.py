@@ -1,0 +1,709 @@
+"""Adversarial-client defense: acceptance tests.
+
+- defense-off path (and neutral defense / benign attack inputs) is bitwise
+  identical to the pre-defense engine;
+- clipping, trimmed-mean and median aggregation match explicit numpy
+  oracles built from per-client deltas;
+- combined in-jit masking: one round where clients are simultaneously
+  deadline-late, non-finite, quarantined, and attacked, checked against a
+  numpy oracle;
+- defense parameters are data: per-round changes never recompile;
+- the ``runner.attack_clients`` injection point (sign_flip / scale /
+  label_flip) and the anomaly->quarantine feedback loop;
+- quarantine preseed blocklists via engine params, validated at submit;
+- chaos acceptance: under a seeded scale attack the defended run's final
+  eval stays within a small epsilon of the clean run while the undefended
+  run measurably degrades, and the attacked+defended run survives a
+  HostPreemption rollback and a supervisor-style resume bitwise.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.engine import build_fedcore, fedavg, make_synthetic_dataset
+from olearning_sim_tpu.engine.client_data import make_central_eval_set
+from olearning_sim_tpu.engine.defense import DefenseConfig
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.engine.runner import (
+    DataPopulation,
+    OperatorSpec,
+    SimulationRunner,
+)
+from olearning_sim_tpu.parallel.mesh import global_put, make_mesh_plan
+from olearning_sim_tpu.performancemgr.performance_manager import PerformanceManager
+from olearning_sim_tpu.resilience import (
+    CLIENT_FLAGGED,
+    CLIENT_QUARANTINED,
+    CLIENT_READMITTED,
+    FaultPlan,
+    FaultSpec,
+    ResilienceLog,
+    faults,
+)
+from olearning_sim_tpu.telemetry import MetricsRegistry
+
+NUM_CLIENTS = 16
+INPUT_SHAPE = (8,)
+
+
+@pytest.fixture(scope="module")
+def plan():
+    return make_mesh_plan()
+
+
+@pytest.fixture(scope="module")
+def core(plan):
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    return build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": (8,), "num_classes": 3},
+        input_shape=INPUT_SHAPE,
+    )
+
+
+@pytest.fixture(scope="module")
+def dataset(plan):
+    return make_synthetic_dataset(
+        7, NUM_CLIENTS, 6, INPUT_SHAPE, 3, class_sep=3.0
+    ).pad_for(plan, 2).place(plan)
+
+
+def _leaves(state):
+    return jax.tree.leaves(jax.device_get(state.params))
+
+
+_DELTA_CACHE = {}
+
+
+def _client_deltas(core, dataset, key=0):
+    """Per-client round deltas d_c extracted one client at a time from the
+    BASE program (participate=onehot(c)); with fedavg's SGD(1.0) server the
+    weighted mean collapses to d_c, so delta = params_after - params_0.
+    The numpy-oracle building block for the aggregation tests (memoized:
+    three tests share the clean-dataset extraction)."""
+    cache_key = (id(core), id(dataset), key)
+    if cache_key in _DELTA_CACHE:
+        return _DELTA_CACHE[cache_key]
+    base = _leaves(core.init_state(jax.random.key(key)))
+    deltas = []
+    for c in range(dataset.num_clients):
+        onehot = np.zeros(dataset.num_clients, np.float32)
+        onehot[c] = 1.0
+        st, _ = core.round_step(
+            core.init_state(jax.random.key(key)), dataset,
+            participate=global_put(onehot, core.plan.client_sharding()),
+        )
+        deltas.append([np.asarray(a, np.float64) - np.asarray(b, np.float64)
+                       for a, b in zip(_leaves(st), base)])
+    _DELTA_CACHE[cache_key] = (base, deltas)
+    return base, deltas
+
+
+def _clip(delta, clip_norm):
+    norm = np.sqrt(sum(float(np.square(l).sum()) for l in delta))
+    if norm > clip_norm:
+        return [l * (clip_norm / norm) for l in delta]
+    return delta
+
+
+# --------------------------------------------------------------- fedcore
+def test_defense_off_neutral_paths_bitwise(core, dataset, plan):
+    """Bitwise defense-off regression: a clip that never binds (mean
+    aggregator) and an all-ones attack vector must reproduce the base
+    program's outputs exactly — masking with nothing masked is free."""
+    base_s, base_m = core.round_step(core.init_state(jax.random.key(0)),
+                                     dataset)
+    neutral = DefenseConfig(clip_norm=1e30)
+    s1, m1 = core.round_step(core.init_state(jax.random.key(0)), dataset,
+                             defense=neutral)
+    for a, b in zip(_leaves(base_s), _leaves(s1)):
+        np.testing.assert_array_equal(a, b)
+    assert float(m1.clipped) == 0.0
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(base_m.client_loss)),
+        np.asarray(jax.device_get(m1.client_loss)),
+    )
+
+    ones = global_put(np.ones(dataset.num_clients, np.float32),
+                      plan.client_sharding())
+    s2, _ = core.round_step(core.init_state(jax.random.key(0)), dataset,
+                            attack_scale=ones)
+    for a, b in zip(_leaves(base_s), _leaves(s2)):
+        np.testing.assert_array_equal(a, b)
+
+    # A disabled config selects the base program object itself.
+    assert not DefenseConfig().enabled
+    key = (False, False, None)
+    assert core._round_step_variants[key] is core._round_step
+
+
+def test_clip_matches_numpy_oracle(core, dataset, plan):
+    """In-jit per-client L2 clipping == clipping each extracted delta in
+    numpy, composed through the weighted mean."""
+    base, deltas = _client_deltas(core, dataset)
+    weights = np.asarray(jax.device_get(dataset.weight), np.float64)
+    norms = np.array([np.sqrt(sum(np.square(l).sum() for l in d))
+                      for d in deltas])
+    clip = float(np.median(norms))  # binds for about half the clients
+    expect_clipped = int(((weights > 0) & (norms > clip)).sum())
+    assert 0 < expect_clipped < dataset.num_clients
+
+    s, m = core.round_step(core.init_state(jax.random.key(0)), dataset,
+                           defense=DefenseConfig(clip_norm=clip))
+    assert int(m.clipped) == expect_clipped
+    w_sum = weights.sum()
+    expected = [
+        np.asarray(b, np.float64)
+        + sum(weights[c] * _clip(deltas[c], clip)[i]
+              for c in range(dataset.num_clients)) / w_sum
+        for i, b in enumerate(base)
+    ]
+    for got, exp in zip(_leaves(s), expected):
+        np.testing.assert_allclose(np.asarray(got, np.float64), exp,
+                                   rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("aggregator", ["trimmed_mean", "median"])
+def test_robust_aggregators_match_numpy_oracle(core, dataset, plan,
+                                               aggregator):
+    """In-jit coordinate-wise trimmed-mean/median == the numpy statistic
+    over the extracted per-client deltas (unweighted over participants)."""
+    base, deltas = _client_deltas(core, dataset)
+    trim = 0.2
+    s, _ = core.round_step(
+        core.init_state(jax.random.key(0)), dataset,
+        defense=DefenseConfig(aggregator=aggregator, trim_fraction=trim),
+    )
+    n = dataset.num_clients
+    k = int(np.floor(trim * n))
+    for i, b in enumerate(base):
+        stacked = np.stack([d[i] for d in deltas])  # [C, ...]
+        if aggregator == "median":
+            agg = np.median(stacked, axis=0)
+        else:
+            srt = np.sort(stacked, axis=0)
+            agg = srt[k:n - k].mean(axis=0)
+        np.testing.assert_allclose(
+            np.asarray(_leaves(s)[i], np.float64), np.asarray(b) + agg,
+            rtol=2e-5, atol=1e-6,
+        )
+
+
+def test_median_neutralizes_scale_attack_mean_does_not(core, dataset, plan):
+    """A x50 scale attack on 3 clients drags the weighted mean but leaves
+    the coordinate-wise median (a minority-robust statistic) near the
+    clean aggregate."""
+    attackers = [1, 5, 9]
+    scale = np.ones(dataset.num_clients, np.float32)
+    scale[attackers] = 50.0
+    atk = global_put(scale, plan.client_sharding())
+
+    clean, _ = core.round_step(core.init_state(jax.random.key(0)), dataset)
+    undefended, _ = core.round_step(
+        core.init_state(jax.random.key(0)), dataset, attack_scale=atk
+    )
+    defended, _ = core.round_step(
+        core.init_state(jax.random.key(0)), dataset, attack_scale=atk,
+        defense=DefenseConfig(aggregator="median"),
+    )
+
+    def dist(s1, s2):
+        return sum(float(np.square(np.asarray(a, np.float64)
+                                   - np.asarray(b, np.float64)).sum())
+                   for a, b in zip(_leaves(s1), _leaves(s2))) ** 0.5
+
+    assert dist(undefended, clean) > 20 * dist(defended, clean)
+
+
+def test_combined_gates_match_numpy_oracle(core, dataset, plan):
+    """Satellite: one round where clients are SIMULTANEOUSLY deadline-late
+    (0), non-finite (1), quarantined (2), sign-flipped (3), and
+    scale-attacked-then-clipped (4), with every gate composed in one
+    compiled program — checked against an explicit numpy oracle built from
+    per-client deltas, plus exact counts for every gate's metric."""
+    C = dataset.num_clients
+    sh = plan.client_sharding()
+    LATE, NAN, QUAR, FLIP, BIG = 0, 1, 2, 3, 4
+
+    # Non-finite client: NaN features baked into a poisoned copy of the
+    # dataset (the runner's poison_clients does exactly this).
+    host_x = np.array(jax.device_get(dataset.x))
+    host_x[NAN] = np.nan
+    from olearning_sim_tpu.engine.client_data import ClientDataset
+
+    poisoned = ClientDataset(
+        x=host_x,
+        y=np.asarray(jax.device_get(dataset.y)),
+        num_samples=np.asarray(jax.device_get(dataset.num_samples)),
+        client_uid=np.asarray(jax.device_get(dataset.client_uid)),
+        weight=np.asarray(jax.device_get(dataset.weight)),
+        num_real_clients=dataset.num_real_clients,
+        population_size=dataset.population_size,
+    ).place(plan, feature_dtype=None)
+
+    base, deltas = _client_deltas(core, poisoned)
+    weights = np.asarray(jax.device_get(dataset.weight), np.float64)
+
+    participate = np.ones(C, np.float32)
+    participate[QUAR] = 0.0                      # quarantine mask
+    completion = np.ones(C, np.float32)
+    completion[LATE] = 10.0                      # misses the deadline
+    deadline = 5.0
+    scale = np.ones(C, np.float32)
+    scale[FLIP] = -1.0                           # sign flip
+    scale[BIG] = 30.0                            # magnitude attack
+    clip = float(np.sqrt(sum(np.square(l).sum() for l in deltas[BIG]))) * 3.0
+    # The x30 attacked delta lands beyond the clip sphere; everyone else
+    # (including the sign flip, same norm) stays inside.
+    norms = np.array([np.sqrt(sum(np.square(l).sum() for l in d))
+                      for d in deltas])
+    assert norms[BIG] * 30.0 > clip and (norms[:5] < clip).all()
+
+    s, m = core.round_step(
+        core.init_state(jax.random.key(0)), poisoned,
+        participate=global_put(participate, sh),
+        completion_time=global_put(completion, sh), deadline=deadline,
+        attack_scale=global_put(scale, sh),
+        defense=DefenseConfig(clip_norm=clip),
+    )
+
+    # Exact gate accounting straight from the compiled program.
+    assert int(m.stragglers) == 1                # LATE
+    assert int(m.clipped) == 1                   # BIG
+    included = [c for c in range(C) if c not in (LATE, NAN, QUAR)]
+    assert int(m.clients_trained) == len(included)
+    assert float(m.weight_sum) == pytest.approx(weights[included].sum())
+
+    # Numpy oracle: excluded clients contribute nothing; FLIP contributes
+    # -d; BIG contributes clip(30 d).
+    def attacked(c):
+        d = [l * float(scale[c]) for l in deltas[c]]
+        return _clip(d, clip)
+
+    w_sum = weights[included].sum()
+    for i, b in enumerate(base):
+        exp = np.asarray(b, np.float64) + sum(
+            weights[c] * attacked(c)[i] for c in included
+        ) / w_sum
+        np.testing.assert_allclose(np.asarray(_leaves(s)[i], np.float64),
+                                   exp, rtol=2e-5, atol=1e-6)
+
+
+def test_defense_params_are_data_no_recompile(core, dataset, plan):
+    """Changing clip_norm / trim_fraction / anomaly_threshold across rounds
+    reuses the SAME compiled program (trace-count asserted via the
+    FedCore trace probe); only the aggregator / scoring structure selects
+    a new variant."""
+    key = (False, False, ("trimmed_mean", True))
+    state = core.init_state(jax.random.key(0))
+    traces_after_first = None
+    for clip, trim, thr in ((1.0, 0.1, 2.0), (7.5, 0.3, 9.0),
+                            (None, 0.05, 4.0)):
+        d = DefenseConfig(clip_norm=clip, aggregator="trimmed_mean",
+                          trim_fraction=trim, anomaly_threshold=thr)
+        state, _ = core.round_step(state, dataset, defense=d)
+        if traces_after_first is None:
+            traces_after_first = core.trace_counts[key]
+    assert core.trace_counts[key] == traces_after_first
+
+    # Attack scales and deadline values are data within their variant too
+    # (the full deadline x attack x defense composition).
+    key = (True, True, ("mean", False))
+    sh = plan.client_sharding()
+    state = core.init_state(jax.random.key(0))
+    traces_after_first = None
+    for factor, dl in ((-1.0, 3.0), (25.0, 9.0), (4.0, 1.5)):
+        scale = np.ones(dataset.num_clients, np.float32)
+        scale[2] = factor
+        state, _ = core.round_step(
+            state, dataset, attack_scale=global_put(scale, sh),
+            completion_time=global_put(
+                np.ones(dataset.num_clients, np.float32), sh
+            ),
+            deadline=dl,
+            defense=DefenseConfig(clip_norm=5.0),
+        )
+        if traces_after_first is None:
+            traces_after_first = core.trace_counts[key]
+    assert core.trace_counts[key] == traces_after_first
+
+
+# ---------------------------------------------------------------- runner
+def make_runner(core, dataset, *, defense=None, rounds=4, task_id="def-task",
+                registry=None, perf=None, checkpointer=None, eval_data=None,
+                operators=None):
+    pop = DataPopulation(
+        name="data_0", dataset=dataset, device_classes=["c"],
+        class_of_client=np.zeros(dataset.num_clients, int),
+        nums=[NUM_CLIENTS], dynamic_nums=[0], eval_data=eval_data,
+    )
+    return SimulationRunner(
+        task_id=task_id, core=core, populations=[pop],
+        operators=operators or [OperatorSpec(name="train")], rounds=rounds,
+        defense=defense, registry=registry, perf=perf,
+        checkpointer=checkpointer,
+    )
+
+
+def test_anomaly_feedback_flags_and_quarantines_attacker(core, dataset):
+    """The full feedback loop: a persistently scale-attacked client is
+    clipped, anomaly-flagged (client_flagged), quarantined out of
+    participation (client_quarantined), and later re-admitted on probation
+    (client_readmitted); metrics and get_performance()["defense"] carry
+    the totals."""
+    log = ResilienceLog()
+    registry = MetricsRegistry()
+    perf = PerformanceManager(registry=registry, resilience_log=log)
+    d = DefenseConfig(clip_norm=5.0, aggregator="trimmed_mean",
+                      trim_fraction=0.2, anomaly_threshold=3.0,
+                      quarantine_after=1, readmit_after=2)
+    runner = make_runner(core, dataset, defense=d, rounds=6,
+                         registry=registry, perf=perf)
+    runner._rlog = log
+    runner._quarantine.log = log
+    attack = FaultPlan(seed=3, specs=[
+        FaultSpec(point="runner.attack_clients", rounds=[0],
+                  payload={"mode": "scale", "factor": 80.0, "clients": [5]}),
+    ])
+    with faults.chaos(attack, log=log):
+        history = runner.run()
+
+    r0 = history[0]["train"]["data_0"]
+    assert r0["attacked"] == 1 and r0["attack_mode"] == "scale"
+    assert r0["clipped"] == 1 and r0["flagged"] == 1
+    assert log.count(CLIENT_FLAGGED) == 1
+    assert log.count(CLIENT_QUARANTINED) == 1
+    quarantined_ev = log.events(CLIENT_QUARANTINED)[0]
+    assert quarantined_ev.detail["clients"] == [5]
+    assert quarantined_ev.detail["via_anomaly"] == 1
+    # Rounds 1-2 exclude the quarantined client; it is readmitted after
+    # readmit_after=2 rounds and, no longer attacked, stays admitted.
+    assert history[1]["train"]["data_0"]["clients_trained"] == NUM_CLIENTS - 1
+    assert log.count(CLIENT_READMITTED) == 1
+    assert history[-1]["train"]["data_0"]["clients_trained"] == NUM_CLIENTS
+
+    clipped = registry.counter(
+        "ols_engine_clipped_total", labels=("task_id",)
+    ).labels(task_id="def-task")
+    assert clipped.value == 1
+    ratio_hist = registry.histogram(
+        "ols_engine_anomaly_ratio", labels=("task_id",)
+    ).labels(task_id="def-task")
+    assert ratio_hist.count > 0
+    summary = perf.get_performance("def-task")
+    assert summary["defense"] == {
+        "clipped_total": 1, "flagged_total": 1, "attacked_total": 1,
+    }
+    assert summary["resilience"].get("client_flagged") == 1
+
+
+def test_label_flip_attack_is_train_scoped(core, dataset):
+    """label_flip trains the targeted round on flipped labels (the train
+    launch sees a swapped label array; training measurably diverges from a
+    clean run) while the dataset outside the launch — same-round eval,
+    later rounds — stays clean (unlike permanent NaN poisoning)."""
+    clean_y = np.asarray(jax.device_get(dataset.y)).copy()
+    seen = {}
+
+    def run(task_id, specs):
+        runner = make_runner(core, dataset, rounds=3, task_id=task_id)
+        orig = runner.core.round_step
+
+        def spy(state, ds, **kw):
+            # The labels the compiled train step actually consumes.
+            seen.setdefault(task_id, []).append(
+                np.asarray(jax.device_get(ds.y)).copy()
+            )
+            return orig(state, ds, **kw)
+
+        runner.core = type(runner.core).__new__(type(runner.core))
+        runner.core.__dict__.update(core.__dict__)
+        runner.core.round_step = spy
+        with faults.chaos(FaultPlan(seed=4, specs=specs),
+                          log=ResilienceLog()):
+            history = runner.run()
+        return runner, history
+
+    attack = [FaultSpec(point="runner.attack_clients", rounds=[1],
+                        payload={"mode": "label_flip", "fraction": 0.25})]
+    runner, history = run("lf-task", attack)
+    _, clean_history = run("lf-task", [])  # same task id = same init model
+
+    assert history[1]["train"]["data_0"]["attacked"] == 4  # ceil(.25 * 16)
+    assert "attacked" not in history[2]["train"]["data_0"]
+    # The train launch of round 1 consumed flipped labels for exactly the
+    # targeted clients...
+    np.testing.assert_array_equal(seen["lf-task"][0], clean_y)
+    flipped = (seen["lf-task"][1] != clean_y).any(axis=1)
+    assert flipped.sum() == 4
+    np.testing.assert_array_equal(seen["lf-task"][2], clean_y)
+    # ...which measurably changed that round's training vs the clean run
+    # (round 0 identical, round 1 diverges)...
+    assert (history[0]["train"]["data_0"]["mean_loss"]
+            == clean_history[0]["train"]["data_0"]["mean_loss"])
+    assert (history[1]["train"]["data_0"]["mean_loss"]
+            != clean_history[1]["train"]["data_0"]["mean_loss"])
+    # ...and the population's dataset is clean outside the train launch.
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(runner.populations[0].dataset.y)), clean_y
+    )
+
+
+def test_sign_flip_targeting_is_seeded_and_population_scoped(core, dataset):
+    """Fraction-based targeting is drawn from (plan seed, round,
+    population) — two runs under the same plan attack identical client
+    sets; a spec matched to another population never fires."""
+    def attacked_sets(task_id):
+        runner = make_runner(core, dataset, rounds=3, task_id=task_id)
+        plan_f = FaultPlan(seed=11, specs=[
+            FaultSpec(point="runner.attack_clients", times=-1,
+                      match="not-this-population",
+                      payload={"mode": "sign_flip", "fraction": 0.9}),
+            FaultSpec(point="runner.attack_clients", times=-1, match="data_0",
+                      payload={"mode": "sign_flip", "fraction": 0.25}),
+        ])
+        out = []
+        orig = runner._run_train
+
+        def spy(p, round_idx, operator):
+            atk = runner._attacks.get(p.name)
+            out.append(tuple(atk["clients"]) if atk else ())
+            return orig(p, round_idx, operator)
+
+        runner._run_train = spy
+        with faults.chaos(plan_f, log=ResilienceLog()):
+            runner.run()
+        return out
+
+    a = attacked_sets("seed-a")
+    b = attacked_sets("seed-b")
+    assert a == b
+    assert all(len(s) == 4 for s in a)          # ceil(0.25 * 16), per round
+    assert len(set(a)) > 1                      # per-round re-draws
+
+
+# ------------------------------------------------- engine params / bridge
+def _bridge_config(extra_params):
+    import copy
+    import os
+
+    cfg_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "configs", "fedavg_mnist_mlp_defense.json",
+    )
+    with open(cfg_path) as f:
+        base = json.load(f)
+    op_info = base["operatorflow"]["operators"][0]["logical_simulation"]
+    params = json.loads(op_info["operator_params"])
+    params.update(copy.deepcopy(extra_params))
+    # Tiny shapes so the bridge test builds fast.
+    params["model"]["overrides"] = {"hidden": [8], "num_classes": 3}
+    params["fedcore"] = {"batch_size": 2, "max_local_steps": 1,
+                         "block_clients": 1}
+    params["data"] = {"synthetic": {"seed": 0, "n_local": 4,
+                                    "num_classes": 3}}
+    op_info["operator_params"] = json.dumps(params)
+    for td in base["target"]["data"]:
+        td["total_simulation"]["nums"] = [4, 4]
+        td["total_simulation"]["dynamic_nums"] = [1, 1]
+        td["allocation"]["logical_simulation"] = [4, 4]
+    return base
+
+
+def test_quarantine_preseed_wires_through_task_bridge():
+    """{"quarantine": {"preseed": ...}} in engine params blocklists the
+    listed device ids from round 0 via the bridge."""
+    from olearning_sim_tpu.engine.task_bridge import build_runner_from_taskconfig
+
+    tj = _bridge_config({"quarantine": {"preseed": {"data_0": [1, 3]}}})
+    runner = build_runner_from_taskconfig(json.dumps(tj))
+    assert runner._quarantine is not None
+    assert runner._quarantine.quarantined("data_0") == [1, 3]
+    assert runner.defense is not None and runner.defense.clip_norm == 10.0
+
+    # Unknown population / out-of-range ids fail loudly at build.
+    tj = _bridge_config({"quarantine": {"preseed": {"nope": [0]}}})
+    with pytest.raises(ValueError, match="unknown population"):
+        build_runner_from_taskconfig(json.dumps(tj))
+    tj = _bridge_config({"quarantine": {"preseed": {"data_0": [999]}}})
+    with pytest.raises(ValueError, match="out of range"):
+        build_runner_from_taskconfig(json.dumps(tj))
+
+
+def test_preseed_only_keeps_blocklist_semantics(core, dataset):
+    """A quarantine.preseed blocklist WITHOUT anomaly scoring or a
+    resilience quarantine config must only fence the listed ids — it must
+    not silently enable strike-based auto-quarantine for the rest of the
+    population (pre-PR a transient non-finite client was gated for that
+    round only)."""
+    pop = DataPopulation(
+        name="data_0", dataset=dataset, device_classes=["c"],
+        class_of_client=np.zeros(dataset.num_clients, int),
+        nums=[NUM_CLIENTS], dynamic_nums=[0],
+    )
+    runner = SimulationRunner(
+        task_id="ps-task", core=core, populations=[pop],
+        operators=[OperatorSpec(name="train")], rounds=2,
+        quarantine_preseed={"data_0": [4]},
+    )
+    poison = FaultPlan(seed=6, specs=[
+        FaultSpec(point="runner.poison_clients", rounds=[0],
+                  payload={"clients": [9]}),
+    ])
+    with faults.chaos(poison, log=ResilienceLog()):
+        history = runner.run()
+    # The blocklisted id stays fenced; the NaN client is gated per round
+    # by the finiteness gate but never auto-quarantined.
+    assert runner._quarantine.quarantined("data_0") == [4]
+    assert history[1]["train"]["data_0"]["clients_trained"] == NUM_CLIENTS - 2
+
+
+def test_malformed_defense_params_rejected_at_submit():
+    """Wrong-shaped defense / quarantine blocks (valid JSON, wrong types)
+    come back as clean validation failures, never as a server error — and
+    the shipped defense config stays valid."""
+    from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+    from olearning_sim_tpu.taskmgr.validation import validate_task_parameters
+
+    for block, bad in (
+        ("defense", "tight"),
+        ("defense", {"aggregator": "krum"}),
+        ("defense", {"clip_nrom": 1.0}),
+        ("defense", {"trim_fraction": 0.7}),
+        ("defense", {"anomaly_threshold": -1.0}),
+        ("quarantine", {"preseed": {"data_0": [-1]}}),
+        ("quarantine", {"preseed": "data_0"}),
+        ("quarantine", {"presed": {}}),
+    ):
+        tj = _bridge_config({block: bad})
+        ok, msg = validate_task_parameters(json2taskconfig(json.dumps(tj)))
+        assert not ok and block in msg, (block, bad, msg)
+
+    # A robust aggregator combined with a control-variate algorithm would
+    # only fail at round time in fedcore; the submit validator must catch
+    # the combination (clip-only stays allowed).
+    tj = _bridge_config({"algorithm": {"name": "scaffold"},
+                         "defense": {"aggregator": "median"}})
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(tj)))
+    assert not ok and "control-variate" in msg, msg
+    tj = _bridge_config({"algorithm": {"name": "scaffold"},
+                         "defense": {"clip_norm": 5.0, "aggregator": "mean",
+                                     "anomaly_threshold": None}})
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(tj)))
+    assert ok, msg
+
+    import os
+
+    cfg_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "configs", "fedavg_mnist_mlp_defense.json",
+    )
+    with open(cfg_path) as f:
+        base = f.read()
+    ok, msg = validate_task_parameters(json2taskconfig(base))
+    assert ok, msg
+
+
+# ------------------------------------------------------ chaos acceptance
+def test_attack_defense_chaos_acceptance(core, dataset, plan, tmp_path):
+    """ISSUE 5 acceptance: under a seeded scale attack on a fixed client
+    fraction, (a) the undefended run's final eval measurably degrades,
+    (b) the defended run stays within a small epsilon of the clean run,
+    and (c) the attacked+defended run survives a HostPreemption rollback
+    AND a supervisor-style relaunch (fresh runner, same checkpoint
+    directory) bitwise."""
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+
+    ds = dataset
+    eval_data = make_central_eval_set(7, 256, INPUT_SHAPE, 3, class_sep=3.0)
+    ATTACKERS = [2, 6, 11, 13]
+    ROUNDS = 6
+
+    def attack_spec():
+        return FaultSpec(point="runner.attack_clients", times=-1,
+                         payload={"mode": "scale", "factor": -8.0,
+                                  "clients": ATTACKERS})
+
+    # trimmed_mean with 0.3 trimmed per tail tolerates the 25% attacker
+    # minority; same program variants as the feedback-loop test, so the
+    # file pays no extra compiles for the acceptance scenario.
+    defense = DefenseConfig(clip_norm=2.0, aggregator="trimmed_mean",
+                            trim_fraction=0.3, anomaly_threshold=3.0,
+                            quarantine_after=1, readmit_after=32)
+
+    def run(task_id, *, defense=None, specs=(), rounds=ROUNDS, ckpt=None):
+        runner = make_runner(
+            core, ds, defense=defense, rounds=rounds, task_id=task_id,
+            eval_data=eval_data, checkpointer=ckpt,
+            operators=[OperatorSpec(name="train"),
+                       OperatorSpec(name="ev", kind="eval")],
+        )
+        log = ResilienceLog()
+        if runner._quarantine is not None:
+            runner._quarantine.log = log
+        runner._rlog = log
+        if runner.resilience is None and specs:
+            from olearning_sim_tpu.resilience import (
+                FailurePolicy,
+                ResilienceConfig,
+            )
+
+            runner.resilience = ResilienceConfig(
+                failure_policy=FailurePolicy.RETRY, max_round_retries=2,
+                quarantine_after=None, log=log,
+            )
+        with faults.chaos(FaultPlan(seed=5, specs=list(specs)), log=log):
+            history = runner.run()
+        return runner, history, log
+
+    _, h_clean, _ = run("chaos-def")  # same task_id: same initial model
+    _, h_atk, _ = run("chaos-def", specs=[attack_spec()])
+    r_def, h_def, _ = run("chaos-def", defense=defense,
+                          specs=[attack_spec()])
+
+    loss_clean = h_clean[-1]["ev"]["data_0"]["eval_loss"]
+    loss_atk = h_atk[-1]["ev"]["data_0"]["eval_loss"]
+    loss_def = h_def[-1]["ev"]["data_0"]["eval_loss"]
+    # Undefended: measurable degradation. Defended: small epsilon.
+    assert loss_atk > loss_clean + 1.0
+    assert abs(loss_def - loss_clean) < 0.5
+    assert loss_def < 0.1 * loss_atk
+    # The defense actually engaged (quarantined the fixed attacker set).
+    assert set(ATTACKERS).issubset(r_def._quarantine.quarantined("data_0"))
+
+    # (c1) HostPreemption mid-run: rollback + checkpoint recovery replays
+    # the attacked+defended rounds bitwise.
+    ck1 = RoundCheckpointer(str(tmp_path / "ck1"), max_to_keep=4)
+    r_pre, h_pre, log_pre = run(
+        "chaos-def", defense=defense, ckpt=ck1,
+        specs=[attack_spec(),
+               FaultSpec(point="runner.round_begin", rounds=[5],
+                         error="preempt")],
+    )
+    assert log_pre.count("rollback") == 1
+    assert [h["round"] for h in h_pre] == list(range(ROUNDS))
+    for a, b in zip(_leaves(r_def.states["data_0"]),
+                    _leaves(r_pre.states["data_0"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # (c2) Supervisor-style resume: a FRESH runner (new process stand-in)
+    # over the same checkpoint directory resumes past the committed rounds
+    # and finishes bitwise — attack targeting is seeded by round and
+    # quarantine state rides the checkpointed history.
+    ck2a = RoundCheckpointer(str(tmp_path / "ck2"), max_to_keep=8)
+    run("chaos-def", defense=defense, ckpt=ck2a, rounds=5,
+        specs=[attack_spec()])
+    ck2a.wait()
+    ck2b = RoundCheckpointer(str(tmp_path / "ck2"), max_to_keep=8)
+    r_res, h_res, _ = run("chaos-def", defense=defense, ckpt=ck2b,
+                          specs=[attack_spec()])
+    assert [h["round"] for h in h_res] == list(range(ROUNDS))
+    for a, b in zip(_leaves(r_def.states["data_0"]),
+                    _leaves(r_res.states["data_0"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The resumed run's quarantine state matches the uninterrupted run's.
+    assert (r_res._quarantine.quarantined("data_0")
+            == r_def._quarantine.quarantined("data_0"))
