@@ -18,6 +18,14 @@ runs on — the conservative direction).
 local-step latency percentiles (the BASELINE metrics of record). The full
 suite also lands in ``BENCH_suite.json``. Set ``OLS_BENCH_FAST=1`` to run
 the headline only.
+
+Scale-out modes (docs/performance.md): ``--chips N`` runs every family on
+a mesh over the first N devices (per-chip normalization reads the mesh
+size, not the host's device count); ``--multichip`` banks the
+chips={1,2,4,8} plain+defended scaling family into
+``BENCH_multichip.json``. All bench processes share the persistent XLA
+compile cache (``artifacts/xla_compile_cache``; ``OLS_COMPILE_CACHE=0``
+disables) and record its hit/miss counters per family.
 """
 
 import json
@@ -51,7 +59,7 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
                local_steps=10, block=256, timed_rounds=3, unroll=1,
                block_unroll=1, carry=None, model_overrides=None,
                vocab_size=None, seq_len=None, deadline_frac=None,
-               attack_frac=None, defense=None):
+               attack_frac=None, defense=None, shard_server=False):
     """One benchmark family: build, warm, time. Returns the record dict.
 
     ``carry``: "bf16" runs local SGD with a bfloat16 params carry (halves
@@ -69,13 +77,21 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
     enables clipping / robust aggregation / anomaly scoring. The delta vs
     the same family without them is the in-jit robust-aggregation
     overhead.
+
+    ``shard_server``: run with the cross-replica sharded server update
+    (FedCoreConfig.shard_server_update — O(params/dp) optimizer state;
+    the chips-scaling family's configuration).
+
+    The record's ``chips`` is the MESH size actually used (``--chips``
+    subdivides the host), not the host's device count.
     """
     import jax.numpy as jnp
 
     carry_dtype = jnp.bfloat16 if carry == "bf16" else None
     cfg = FedCoreConfig(batch_size=batch, max_local_steps=local_steps,
                         block_clients=block, step_unroll=unroll,
-                        block_unroll=block_unroll, carry_dtype=carry_dtype)
+                        block_unroll=block_unroll, carry_dtype=carry_dtype,
+                        shard_server_update=bool(shard_server))
     core = build_fedcore(model, algorithm, plan, cfg,
                          model_overrides=model_overrides,
                          input_shape=input_shape)
@@ -158,7 +174,10 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
     return {
         "family": name,
         "backend": jax.default_backend(),
-        "chips": len(jax.devices()),
+        # The mesh the family actually ran on (per-chip normalization and
+        # the chips-scaling curves read this), NOT len(jax.devices()) —
+        # --chips subdivides the host.
+        "chips": plan.n_devices,
         "carry": carry or "f32",
         "clients": num_clients,
         "local_steps": local_steps,
@@ -177,6 +196,7 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
         **({"defense": defense.aggregator,
             "clipped": int(metrics.clipped)}
            if defense is not None else {}),
+        **({"shard_server": True} if shard_server else {}),
     }
 
 
@@ -347,6 +367,7 @@ _PRINTED_RESULT = False
 def main():
     global _PRINTED_RESULT
     backend, degraded = select_backend()
+    _enable_compile_cache()
     on_cpu = backend == "cpu"
     # OLS_BENCH_FAST=1 is the only headline-only mode: a CPU/degraded run
     # still covers the breadth suite (shrunk via CPU_SUITE_SHRINK) so every
@@ -616,8 +637,10 @@ def make_algorithm(spec):
     return builders[name](lr, **kw)
 
 
-def run_family_subprocess(fam, timeout_s=None):
-    """Run one suite family in a child process with a hard timeout."""
+def run_family_subprocess(fam, timeout_s=None, env=None):
+    """Run one suite family in a child process with a hard timeout.
+    ``env`` overrides the child's environment (the multichip sweep uses it
+    to force a per-chips-count CPU device grid)."""
     import subprocess
     import tempfile
 
@@ -627,7 +650,8 @@ def run_family_subprocess(fam, timeout_s=None):
                "--one", json.dumps(fam), "--out", out.name]
         try:
             proc = subprocess.run(
-                cmd, timeout=timeout_s, capture_output=True, text=True
+                cmd, timeout=timeout_s, capture_output=True, text=True,
+                env=env,
             )
         except subprocess.TimeoutExpired as e:
             # Keep the killed child's stderr — that's the wedge diagnostic
@@ -661,6 +685,12 @@ def _resilience_counters():
 def run_one_inprocess(plan, fam):
     fam = dict(fam)
     fam["algorithm"] = make_algorithm(fam["algorithm"])
+    chips = fam.pop("chips", None) or _env_chips()
+    if chips:
+        # --chips: measure on a subdivided mesh; per-chip normalization
+        # reads the record's mesh-derived "chips" field, so the curves
+        # stay honest.
+        plan = _plan_for_chips(chips)
     # The global log is process-cumulative; in-process suite runs share one
     # process, so record the delta or family N would inherit families
     # 1..N-1's retries.
@@ -713,26 +743,163 @@ def run_family_once(name):
         sys.exit(4)
 
 
+def _plan_for_chips(chips):
+    """Mesh over the first ``chips`` devices (default: all) — the --chips
+    knob that captures scaling curves on one host by subdividing it."""
+    if not chips:
+        return make_mesh_plan()
+    devices = jax.devices()
+    if len(devices) < int(chips):
+        raise RuntimeError(
+            f"--chips {chips}: host exposes only {len(devices)} devices "
+            f"(on CPU, set --xla_force_host_platform_device_count)"
+        )
+    return make_mesh_plan(devices=devices[: int(chips)])
+
+
 def run_one(fam_json, out_path):
     plat = os.environ.get("OLS_FORCE_PLATFORM")
     if plat:
         # Parent degraded to CPU; env alone is not enough when a
         # sitecustomize pins the hardware plugin over JAX_PLATFORMS.
         jax.config.update("jax_platforms", plat)
+    _enable_compile_cache()
     fam = json.loads(fam_json)
     fam["algorithm"] = make_algorithm(tuple(fam["algorithm"]))
     if fam.get("input_shape") is not None:
         fam["input_shape"] = tuple(fam["input_shape"])
-    record = run_family(make_mesh_plan(), **fam)
+    plan = _plan_for_chips(fam.pop("chips", None) or _env_chips())
+    record = run_family(plan, **fam)
     record.setdefault("resilience", _resilience_counters())
+    record.setdefault("compile_cache", _cache_counters())
     with open(out_path, "w") as f:
         json.dump(record, f)
 
 
+def _env_chips():
+    chips = os.environ.get("OLS_BENCH_CHIPS")
+    return int(chips) if chips else None
+
+
+def _enable_compile_cache():
+    """Persistent XLA compile cache for bench processes: suite children,
+    multichip children and repeat sweeps share artifacts/xla_compile_cache
+    so only the FIRST process compiles each variant. Never fatal."""
+    try:
+        from olearning_sim_tpu.engine.compile_cache import (
+            enable_compile_cache,
+        )
+
+        return enable_compile_cache()
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        print(f"compile cache unavailable: {e}", file=sys.stderr)
+        return None
+
+
+def _cache_counters():
+    """{"hits": n, "misses": n} from this process's telemetry listener."""
+    try:
+        from olearning_sim_tpu.engine.compile_cache import cache_stats
+
+        return cache_stats()
+    except Exception:  # noqa: BLE001 — accounting must not kill the bench
+        return {}
+
+
+# ---------------------------------------------------------- multichip
+# The chips={1,2,4,8} scaling family (ISSUE 6 / ROADMAP item 1): the SAME
+# mlp family measured at every mesh size, plain and defended, with the
+# cross-replica sharded server update on. On CPU each chips-count child is
+# forced to a matching virtual device grid; records are marked degraded
+# exactly like the main suite. Results land in BENCH_multichip.json next
+# to BENCH_tpu.json's 1-chip headline.
+MULTICHIP_CHIPS = (1, 2, 4, 8)
+MULTICHIP_FAMILY = dict(
+    name="fedavg_mnist_mlp_multichip", model="mlp2",
+    algorithm=("fedavg", dict(local_lr=0.05)), num_clients=512, n_local=8,
+    input_shape=(28, 28, 1), block=8, unroll=1, batch=8, local_steps=2,
+    timed_rounds=2, shard_server=True,
+)
+MULTICHIP_DEFENSE = dict(clip_norm=10.0, aggregator="trimmed_mean",
+                         trim_fraction=0.15, anomaly_threshold=4.0)
+MULTICHIP_TIMEOUT_S = int(os.environ.get("OLS_BENCH_MULTICHIP_TIMEOUT",
+                                         "600"))
+
+
+def run_multichip(out_name="BENCH_multichip.json"):
+    """Capture the chips-scaling family; prints one JSON line per entry
+    and banks the whole family atomically."""
+    import re
+
+    backend, degraded = select_backend()
+    # Scaling curves are a throughput claim: anything that is not real
+    # accelerator hardware is a degraded measurement (CPU "chips" share
+    # one socket's FLOPs), even when CPU is the platform's healthy
+    # default backend.
+    degraded = degraded or backend != "tpu"
+    entries = []
+    for chips in MULTICHIP_CHIPS:
+        for program, extra in (
+            ("plain", {}),
+            ("defended", {"attack_frac": 0.1,
+                          "defense": MULTICHIP_DEFENSE}),
+        ):
+            fam = {**MULTICHIP_FAMILY, **extra, "chips": chips,
+                   "name": f"{MULTICHIP_FAMILY['name']}_{program}_c{chips}"}
+            env = dict(os.environ)
+            if backend == "cpu":
+                # Subdivide one host: the child sees exactly `chips`
+                # virtual CPU devices, so the dp mesh is the real thing.
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", "",
+                    env.get("XLA_FLAGS", ""),
+                ).strip()
+                env["XLA_FLAGS"] = (
+                    f"{flags} "
+                    f"--xla_force_host_platform_device_count={chips}"
+                ).strip()
+            record = run_family_subprocess(
+                fam, timeout_s=MULTICHIP_TIMEOUT_S, env=env
+            )
+            record.update(program=program, chips_requested=chips,
+                          backend=record.get("backend", backend),
+                          degraded=degraded)
+            record.setdefault("captured_unix", round(time.time(), 1))
+            print(json.dumps(record), flush=True)
+            entries.append(record)
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), out_name
+    )
+    payload = {
+        "captured_unix": round(time.time(), 1),
+        "backend": backend,
+        "degraded": degraded,
+        "family": MULTICHIP_FAMILY["name"],
+        "note": ("rounds/sec per mesh size for the plain and defended "
+                 "(clip+trimmed_mean+anomaly) programs with the sharded "
+                 "server update; compare BENCH_tpu.json's 1-chip 0.73 "
+                 "rounds/sec headline. CPU entries are degraded "
+                 "measurements (methodology: docs/performance.md)."),
+        "entries": entries,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+    return payload
+
+
 if __name__ == "__main__":
+    if "--chips" in sys.argv:
+        # Subdivide the host for every family this invocation measures
+        # (scaling curves on one host). Children inherit via the fam dict;
+        # the in-process paths read it back out of the environment.
+        os.environ["OLS_BENCH_CHIPS"] = sys.argv[sys.argv.index("--chips") + 1]
     if "--one" in sys.argv:
         i = sys.argv.index("--one")
         run_one(sys.argv[i + 1], sys.argv[sys.argv.index("--out") + 1])
+    elif "--multichip" in sys.argv:
+        run_multichip()
     elif "--family" in sys.argv:
         run_family_once(sys.argv[sys.argv.index("--family") + 1])
     else:

@@ -32,11 +32,21 @@ untouched pre-defense program (regression-tested bitwise). Choosing a
 different ``aggregator`` (or toggling scoring) is structural and selects a
 distinct lazily-compiled program variant.
 
-Memory note: ``trimmed_mean`` / ``median`` / anomaly scoring materialize the
-per-client delta matrix (``all_gather`` over the ``dp`` axis —
-``num_clients × model_params`` f32 per device). That is the intrinsic cost
-of coordinate-wise robust statistics; clipping alone stays fully streaming
-(no extra memory) and composes with the default weighted mean at any scale.
+Memory note: coordinate-wise robust statistics need every client's value
+for each coordinate — but not every coordinate on every device. The round
+program therefore ``all_to_all``s the clipped per-client deltas over ``dp``
+(:func:`shard_client_deltas`): each device ends up holding *all* clients
+for 1/dp of the flattened coordinates, so the per-device peak is
+``num_clients × model_params / dp`` f32 instead of the full
+``num_clients × model_params`` matrix an ``all_gather`` would materialize.
+The per-coordinate sort + index-window statistics are computed on each
+coordinate shard exactly as they would be on the full matrix (bit-for-bit
+the same aggregate — every coordinate's client column is intact), and
+Krum-style scores combine per-shard partial squared distances with one
+``psum`` (:func:`partial_distance_sq`). Clipping alone stays fully
+streaming (no extra memory) and composes with the default weighted mean at
+any scale. ``scripts/check_hlo_collectives.py`` lints the lowered round
+program so an O(clients×params) ``all-gather`` can never silently return.
 """
 
 from __future__ import annotations
@@ -46,6 +56,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 AGGREGATORS = ("mean", "trimmed_mean", "median")
 
@@ -229,3 +240,63 @@ def distance_scores(stacked: Any, center: Any, mask: jax.Array) -> jax.Array:
     if total is None:
         return jnp.zeros_like(mask, jnp.float32)
     return jnp.where(mask, jnp.sqrt(total), 0.0)
+
+
+# ------------------------------------------------- sharded (all_to_all) path
+# The scale-out formulation of the helpers above, used inside the compiled
+# round program (``shard_map`` manual over ``dp``). Layout contract shared
+# by all three functions AND fedcore's sharded server update: a leaf's
+# flattened coordinates are zero-padded to a multiple of the axis size and
+# split into ``dp`` contiguous blocks, device ``i`` owning block ``i``.
+
+def pad_to_axis(flat: jax.Array, axis_size: int) -> jax.Array:
+    """Zero-pad (trailing) the last axis to ``mesh.pad_to_multiple`` of
+    ``axis_size`` — the SAME target-size rule fedcore's ``_flat_pad_leaf``
+    uses, which is what lets a robust-aggregate coordinate shard feed the
+    sharded server update directly."""
+    from olearning_sim_tpu.parallel.mesh import pad_to_multiple
+
+    pad = pad_to_multiple(flat.shape[-1], axis_size) - flat.shape[-1]
+    if pad:
+        flat = jnp.pad(flat, [(0, 0)] * (flat.ndim - 1) + [(0, pad)])
+    return flat
+
+
+def shard_client_deltas(leaf: jax.Array, axis_name: str,
+                        axis_size: int) -> jax.Array:
+    """One device's per-client delta leaf [c_local, ...] -> a coordinate
+    shard [C, D_pad/dp] holding ALL clients for this device's 1/dp of the
+    (flattened, padded) coordinates — one ``all_to_all``, no replication.
+    Client rows follow device order, matching a tiled ``all_gather``."""
+    c_local = leaf.shape[0]
+    flat = pad_to_axis(
+        leaf.reshape(c_local, -1).astype(jnp.float32), axis_size
+    )
+    return jax.lax.all_to_all(
+        flat, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )
+
+
+def place_coordinate_shard(shard: jax.Array, axis_name: str, axis_size: int,
+                           shape) -> jax.Array:
+    """Invert the coordinate sharding for one aggregated leaf: each device
+    contributes its [D_pad/dp] block into zeros at its own offset and a
+    ``psum`` stitches the full vector — supports are disjoint, so the sum
+    is exact (bitwise) and the result is identically replicated (axis-
+    invariant, so it can exit ``shard_map`` through a replicated spec)."""
+    s = shard.shape[0]
+    full = jnp.zeros((s * axis_size,), shard.dtype)
+    full = jax.lax.dynamic_update_slice(
+        full, shard, (jax.lax.axis_index(axis_name) * s,)
+    )
+    full = jax.lax.psum(full, axis_name)
+    return full[: int(np.prod(shape, dtype=np.int64))].reshape(shape)
+
+
+def partial_distance_sq(shard: jax.Array, center_shard: jax.Array) -> jax.Array:
+    """This shard's contribution to every client's squared distance from
+    ``center``: [C, D_pad/dp] x [D_pad/dp] -> [C]. ``psum`` the partials
+    over ``dp``, then sqrt, to recover :func:`distance_scores`."""
+    diff = shard.astype(jnp.float32) \
+        - center_shard.reshape(1, -1).astype(jnp.float32)
+    return jnp.square(diff).sum(axis=1)

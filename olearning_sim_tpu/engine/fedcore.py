@@ -43,7 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from olearning_sim_tpu.engine.algorithms import Algorithm
 from olearning_sim_tpu.engine.client_data import ClientDataset
-from olearning_sim_tpu.parallel.mesh import MeshPlan, global_put
+from olearning_sim_tpu.parallel.mesh import MeshPlan, global_put, pad_to_multiple
 
 from olearning_sim_tpu.utils.compat import ensure_jax_compat
 
@@ -157,6 +157,18 @@ class FedCoreConfig:
     # (tests/test_parity_cnn.py::test_bf16_carry_parity) before shipping a
     # measured config with it.
     carry_dtype: Any = None
+    # Cross-replica sharded server update (arXiv 2004.13336): the weighted
+    # delta is reduce-scattered over ``dp``, the optax update runs on each
+    # chip's 1/dp slice of the flattened params with the optimizer state
+    # laid out the same way (O(params/dp) resident per chip instead of a
+    # full replica), and fresh params are stitched back from the disjoint
+    # shards. Results match the replicated update to float-reduction order
+    # (bitwise for the shard-local elementwise transform itself; the
+    # reduce-scatter may re-associate the cross-replica sum). Requires an
+    # elementwise server optimizer (every optax built-in the algorithms
+    # use qualifies) and is mutually exclusive with tensor parallelism
+    # (mp > 1).
+    shard_server_update: bool = False
 
     def __post_init__(self):
         # scan(unroll=0) and zero-length loops fail at trace time with
@@ -168,6 +180,10 @@ class FedCoreConfig:
                 raise ValueError(
                     f"FedCoreConfig.{fld} must be an int >= 1, got {v!r}"
                 )
+        if self.sample_mode not in ("auto", "gather", "multiplicity"):
+            # Checked here (not only lazily in use_multiplicity) so a bad
+            # value fails at submit validation, not at first trace.
+            raise ValueError(f"unknown sample_mode {self.sample_mode!r}")
 
     def use_multiplicity(self, n_local: int) -> bool:
         if self.sample_mode == "multiplicity":
@@ -177,6 +193,71 @@ class FedCoreConfig:
         if self.sample_mode != "auto":
             raise ValueError(f"unknown sample_mode {self.sample_mode!r}")
         return n_local <= 2 * self.batch_size
+
+    @classmethod
+    def from_dict(cls, obj: dict) -> "FedCoreConfig":
+        """Engine-params JSON shape (``{"fedcore": {...}}``)::
+
+            {"batch_size": 32, "max_local_steps": 10, "block_clients": 64,
+             "step_unroll": 1, "block_unroll": 1, "sample_mode": "auto",
+             "carry_dtype": "bf16", "personal_dtype": "bf16",
+             "shard_server_update": false}
+
+        Typos and wrong-typed knobs fail at submit time
+        (``taskmgr/validation.py``) rather than mid-round. Dtype knobs
+        accept ``"bf16"``/``"bfloat16"``/``"f32"``/``"float32"`` (or any
+        floating numpy dtype string); ``null`` keeps the default f32 path.
+        """
+        if not isinstance(obj, dict):
+            raise TypeError(
+                f"fedcore config must be a JSON object, got "
+                f"{type(obj).__name__}"
+            )
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(obj) - known)
+        if unknown:
+            # A typo (cary_dtype) must fail at submit time, not silently
+            # run the full-precision path.
+            raise ValueError(
+                f"unknown fedcore config keys: {unknown} "
+                f"(known: {sorted(known)})"
+            )
+        kw: dict = {}
+        for k in ("batch_size", "max_local_steps", "block_clients",
+                  "eval_batch_size", "step_unroll", "block_unroll"):
+            if k in obj and obj[k] is not None:
+                kw[k] = int(obj[k])
+        if obj.get("sample_mode") is not None:
+            kw["sample_mode"] = str(obj["sample_mode"])
+        if obj.get("aux_loss_weight") is not None:
+            kw["aux_loss_weight"] = float(obj["aux_loss_weight"])
+        if obj.get("shard_server_update") is not None:
+            kw["shard_server_update"] = bool(obj["shard_server_update"])
+        for k in ("carry_dtype", "personal_dtype"):
+            if obj.get(k) is not None:
+                kw[k] = parse_float_dtype(k, obj[k])
+        return cls(**kw)
+
+
+def parse_float_dtype(knob: str, value):
+    """A validated engine-params dtype knob (``carry_dtype`` /
+    ``personal_dtype``): dtype-like values pass through; strings accept the
+    common bf16/f32 shorthands. Non-floating dtypes are rejected — these
+    knobs select a *precision*, and an int dtype would silently corrupt the
+    SGD carry."""
+    aliases = {"bf16": jnp.bfloat16, "f32": jnp.float32,
+               "fp32": jnp.float32, "f16": jnp.float16}
+    if isinstance(value, str) and value in aliases:
+        value = aliases[value]
+    try:
+        dt = jnp.dtype(value)
+    except TypeError as e:
+        raise ValueError(f"fedcore.{knob}: not a dtype: {value!r}") from e
+    if not jnp.issubdtype(dt, jnp.floating):
+        raise ValueError(
+            f"fedcore.{knob} must be a floating dtype, got {dt.name!r}"
+        )
+    return dt
 
 
 def _to_varying(tree, axis: str):
@@ -199,6 +280,26 @@ def _to_varying(tree, axis: str):
 
 def _tree_where(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _flat_pad_leaf(p, multiple: int):
+    """Flatten a leaf and zero-pad to a multiple of ``multiple`` — the
+    coordinate layout shared by the sharded server update and the sharded
+    robust aggregation (defense.shard_client_deltas pads identically, so a
+    robust aggregate shard can feed the sharded optimizer directly)."""
+    flat = p.reshape(-1)
+    target = pad_to_multiple(flat.shape[0], multiple)
+    if target != flat.shape[0]:
+        flat = jnp.pad(flat, (0, target - flat.shape[0]))
+    return flat
+
+
+def _dp_shardable(leaf, dp: int) -> bool:
+    """Whether an optimizer-state leaf carries per-coordinate state (flat,
+    dp-divisible — shard it) as opposed to a replicated scalar like Adam's
+    step count (keep it whole on every chip)."""
+    shape = getattr(leaf, "shape", ())
+    return len(shape) >= 1 and shape[0] > 0 and shape[0] % dp == 0
 
 
 def _tree_l2_sq(a, b):
@@ -248,6 +349,40 @@ class FedCore:
                 "control_variates needs algorithm.local_lr > 0 (the "
                 "option-II refresh divides by K * local_lr)"
             )
+        # Cross-replica sharded server update (arXiv 2004.13336): the
+        # optimizer state lives as flat per-coordinate shards over dp
+        # (O(params/dp) per chip). The PartitionSpec tree is derived once
+        # from the optimizer-state structure so init_state, the shard_map
+        # specs, and checkpoint templates can never disagree on layout.
+        self._opt_spec = None
+        if config.shard_server_update:
+            if param_specs is not None:
+                raise ValueError(
+                    "shard_server_update is mutually exclusive with "
+                    "tensor-parallel param_specs (mp > 1): the flat dp "
+                    "coordinate shards would cut across the mp sharding"
+                )
+            p_shapes = jax.eval_shape(init_params_fn, jax.random.key(0))
+            flat_t = jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct(
+                    (pad_to_multiple(
+                        int(np.prod(p.shape, dtype=np.int64)), plan.dp
+                    ),),
+                    p.dtype,
+                ),
+                p_shapes,
+            )
+            opt_t = jax.eval_shape(algorithm.server_optimizer.init, flat_t)
+            # Shardability is decided HERE, on the global template — inside
+            # shard_map the same leaves appear shard-local ([D_pad/dp]),
+            # where a shape test would misclassify them.
+            self._opt_sharded = jax.tree.map(
+                lambda l: _dp_shardable(l, plan.dp), opt_t
+            )
+            self._opt_spec = jax.tree.map(
+                lambda sharded: P("dp") if sharded else P(),
+                self._opt_sharded,
+            )
         self._round_step = self._build_round_step()
         # Program variants keyed by (with_deadline, with_attack,
         # defense_structure): built on first use so tasks that never set a
@@ -278,6 +413,32 @@ class FedCore:
         # multi-host meshes, where the sharding spans non-addressable devices.
         rep = self.plan.replicated()
         shardings = self._param_shardings()
+        if self.config.shard_server_update:
+            # Params stay replicated (eval/export/checkpoint see the normal
+            # tree); the optimizer state is initialized over the FLAT padded
+            # coordinate view and placed sharded over dp — zeros either
+            # way, so the values are bitwise those of the replicated init.
+            mesh = self.plan.mesh
+            opt_sh = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), self._opt_spec,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            pk, bk = jax.jit(jax.random.split, out_shardings=rep)(rng)
+            params = jax.jit(self.init_params_fn, out_shardings=rep)(pk)
+
+            def make_opt(params):
+                flat = jax.tree.map(
+                    lambda p: _flat_pad_leaf(p, self.plan.dp), params
+                )
+                return self.algorithm.server_optimizer.init(flat)
+
+            opt_state = jax.jit(make_opt, out_shardings=opt_sh)(params)
+            return ServerState(
+                params=params,
+                opt_state=opt_state,
+                round_idx=jax.jit(lambda: jnp.int32(0), out_shardings=rep)(),
+                base_key=bk,
+            )
         if shardings is None:
 
             def make(rng):
@@ -536,8 +697,10 @@ class FedCore:
         ``trim_fraction`` — and composes per-client L2 delta clipping,
         optional coordinate-wise trimmed-mean/median aggregation, and
         Krum-style per-client anomaly scores (``metrics.anomaly_score``)
-        into the same compiled program (pure ``lax``; see engine/defense.py
-        for the memory trade-off of the gathering aggregators).
+        into the same compiled program (pure ``lax``; the robust
+        aggregators/scores run coordinate-SHARDED over dp via one
+        all_to_all — O(clients x params / dp) peak per device, see
+        engine/defense.py).
 
         The default variant is byte-identical to the pre-deadline,
         pre-defense program."""
@@ -545,11 +708,14 @@ class FedCore:
         cfg = self.config
         alg = self.algorithm
         mesh = plan.mesh
+        dpn = plan.dp
+        shard_update = cfg.shard_server_update
         personalized = alg.personalized
         controlled = alg.control_variates
         defense_gather = defense is not None and defense.gathers_deltas
         defense_score = defense is not None and defense.score_enabled
         aggregator = defense.aggregator if defense is not None else "mean"
+        robust_agg = aggregator in ("trimmed_mean", "median")
         trace_key = (with_deadline, with_attack,
                      defense.structure_key if defense is not None else None)
 
@@ -786,8 +952,11 @@ class FedCore:
                 )
 
             # Cross-device FedAvg: the Pulsar gradient transport of the
-            # reference becomes one psum over the dp axis of the ICI mesh.
-            sum_delta = jax.lax.psum(sum_delta, "dp")
+            # reference becomes one collective over the dp axis of the ICI
+            # mesh — a full psum of the weighted delta on the replicated
+            # path, or a reduce-scatter (each chip keeps the cross-replica
+            # sum for its 1/dp of the coordinates) under the sharded
+            # server update.
             sum_w = jax.lax.psum(sum_w, "dp")
             sum_loss = jax.lax.psum(sum_loss, "dp")
             count = jax.lax.psum(count, "dp")
@@ -796,48 +965,90 @@ class FedCore:
                 n_clip = jax.lax.psum(n_clip, "dp")
 
             denom = jnp.maximum(sum_w, 1e-8)
-            mean_delta = jax.tree.map(lambda s: s / denom, sum_delta)
+            mean_delta = delta_shards = None
+            if not (defense_gather and robust_agg):
+                # Weighted-mean aggregation (a robust aggregator replaces
+                # it entirely below, so its collective is skipped then).
+                if shard_update:
+                    delta_shards = jax.tree.map(
+                        lambda s: jax.lax.psum_scatter(
+                            _flat_pad_leaf(s, dpn), "dp",
+                            scatter_dimension=0, tiled=True,
+                        ) / denom,
+                        sum_delta,
+                    )
+                else:
+                    sum_delta = jax.lax.psum(sum_delta, "dp")
+                    mean_delta = jax.tree.map(lambda s: s / denom, sum_delta)
             anomaly_score = jnp.float32(0.0)
             if defense_gather:
-                # The robust aggregators / anomaly scores need the full
-                # per-client delta matrix: un-block this shard's clipped
-                # deltas and all-gather them over dp (every device then
-                # holds all C clients — see engine/defense.py for the
-                # memory trade-off).
+                # Sharded robust aggregation: one all_to_all re-lays the
+                # clipped per-client deltas so THIS device holds every
+                # client for 1/dp of the coordinates — peak
+                # O(clients x params / dp) instead of the full
+                # O(clients x params) matrix an all_gather would
+                # replicate. Each coordinate's client column is intact, so
+                # the per-coordinate sort/window statistics are bit-for-bit
+                # those of the gathered formulation.
                 from olearning_sim_tpu.engine import defense as defense_mod
 
                 d_pc, w_pc = defense_out
-                d_all = jax.tree.map(
-                    lambda a: jax.lax.all_gather(
-                        a.reshape((c_local,) + a.shape[2:]), "dp", tiled=True
-                    ),
-                    d_pc,
-                )
+                # The participant mask is the only thing replicated in
+                # full — O(clients) bytes.
                 w_all = jax.lax.all_gather(
                     w_pc.reshape((c_local,)), "dp", tiled=True
                 )
                 participants = w_all > 0
-                center = None
-                if aggregator in ("trimmed_mean", "median"):
-                    agg = defense_mod.robust_aggregate(
-                        d_all, participants, aggregator, trim_fraction
+                shards = jax.tree.map(
+                    lambda a: defense_mod.shard_client_deltas(
+                        a.reshape((c_local,) + a.shape[2:]), "dp", dpn
+                    ),
+                    d_pc,
+                )
+                center_shards = None
+                if robust_agg:
+                    agg_shards = jax.tree.map(
+                        lambda s: defense_mod.robust_leaf_aggregate(
+                            s, participants, aggregator, trim_fraction
+                        ),
+                        shards,
                     )
                     if aggregator == "median":
-                        center = agg
-                    # Identical on every device (deterministic ops over
-                    # all-gathered data); pmax re-types the value as
-                    # axis-invariant without changing a single bit so it
-                    # can exit through the replicated out_spec.
-                    mean_delta = jax.tree.map(
-                        lambda a: jax.lax.pmax(a, "dp"), agg
-                    )
-                if defense_score:
-                    if center is None:
-                        center = defense_mod.robust_aggregate(
-                            d_all, participants, "median", trim_fraction
+                        center_shards = agg_shards
+                    if shard_update:
+                        # Same coordinate partition as the sharded server
+                        # update (_flat_pad_leaf pads identically), so the
+                        # robust aggregate feeds the sharded optimizer
+                        # directly — no reconstruction collective at all.
+                        delta_shards = agg_shards
+                    else:
+                        mean_delta = jax.tree.map(
+                            lambda s, p: defense_mod.place_coordinate_shard(
+                                s, "dp", dpn, p.shape
+                            ),
+                            agg_shards, params,
                         )
-                    scores = defense_mod.distance_scores(
-                        d_all, center, participants
+                if defense_score:
+                    if center_shards is None:
+                        center_shards = jax.tree.map(
+                            lambda s: defense_mod.robust_leaf_aggregate(
+                                s, participants, "median", trim_fraction
+                            ),
+                            shards,
+                        )
+                    # Krum-style distances from per-shard partial squared
+                    # distances combined with one psum; sqrt after the sum
+                    # recovers the gathered formulation's scores.
+                    partial = functools.reduce(
+                        jnp.add,
+                        [defense_mod.partial_distance_sq(s, c)
+                         for s, c in zip(jax.tree.leaves(shards),
+                                         jax.tree.leaves(center_shards))],
+                    )
+                    scores = jnp.where(
+                        participants,
+                        jnp.sqrt(jax.lax.psum(partial, "dp")),
+                        0.0,
                     )
                     # Each shard exits with its own clients' scores (same
                     # layout as client_loss).
@@ -848,13 +1059,61 @@ class FedCore:
                     )
             # Server optimizer consumes the negative mean delta as a
             # pseudo-gradient (FedOpt formulation).
-            pseudo_grad = jax.tree.map(
-                lambda d, p: (-d).astype(p.dtype), mean_delta, params
-            )
-            updates, new_opt_state = alg.server_optimizer.update(
-                pseudo_grad, opt_state, params
-            )
-            new_params = optax.apply_updates(params, updates)
+            if shard_update:
+                # Cross-replica sharded weight update (arXiv 2004.13336):
+                # update THIS chip's 1/dp coordinate slice with the
+                # optimizer state that lives sharded the same way, then
+                # stitch the fresh params from the disjoint shards (exact
+                # — each coordinate has exactly one contributor).
+                from olearning_sim_tpu.engine import defense as defense_mod
+
+                def my_shard(p):
+                    flat = _flat_pad_leaf(p, dpn)
+                    s = flat.shape[0] // dpn
+                    return jax.lax.dynamic_slice(
+                        flat, (jax.lax.axis_index("dp") * s,), (s,)
+                    )
+
+                shard_params = jax.tree.map(my_shard, params)
+                pseudo_grad = jax.tree.map(
+                    lambda d, p: (-d).astype(p.dtype),
+                    delta_shards, shard_params,
+                )
+                # Replicated state (Adam's count) stays whole on every
+                # chip; type it varying on entry and re-type on exit (pmax
+                # over identical values — a bitwise no-op) so it can cross
+                # the sharded update on VMA runtimes. The sharded/
+                # replicated split comes from the build-time template
+                # (self._opt_sharded) — a shape test here would see
+                # shard-LOCAL leaves and misclassify them.
+                opt_in = jax.tree.map(
+                    lambda l, sharded: l if sharded
+                    else _to_varying(l, "dp"),
+                    opt_state, self._opt_sharded,
+                )
+                updates, new_opt_state = alg.server_optimizer.update(
+                    pseudo_grad, opt_in, shard_params
+                )
+                new_shards = optax.apply_updates(shard_params, updates)
+                new_opt_state = jax.tree.map(
+                    lambda l, sharded: l if sharded
+                    else jax.lax.pmax(l, "dp"),
+                    new_opt_state, self._opt_sharded,
+                )
+                new_params = jax.tree.map(
+                    lambda s, p: defense_mod.place_coordinate_shard(
+                        s, "dp", dpn, p.shape
+                    ),
+                    new_shards, params,
+                )
+            else:
+                pseudo_grad = jax.tree.map(
+                    lambda d, p: (-d).astype(p.dtype), mean_delta, params
+                )
+                updates, new_opt_state = alg.server_optimizer.update(
+                    pseudo_grad, opt_state, params
+                )
+                new_params = optax.apply_updates(params, updates)
             new_server_c = None
             if controlled:
                 # c <- c + (|S|/N) * weighted-mean dc_i (SCAFFOLD eq. 5 with
@@ -895,6 +1154,12 @@ class FedCore:
         defense_specs = (rep, rep) if defense is not None else ()
         extra_specs = pace_specs + attack_specs + defense_specs
 
+        # Optimizer state is replicated on the classic path; under the
+        # sharded server update its per-coordinate leaves ride in/out as
+        # flat dp shards (scalar leaves stay replicated) per the spec tree
+        # derived at construction.
+        opt_spec = self._opt_spec if shard_update else rep
+
         def make_shard_fn(vp_tree, sc_tree=None):
             vp_spec = jax.tree.map(lambda _: cl, vp_tree)
             sc_spec = jax.tree.map(lambda _: rep, sc_tree)
@@ -904,9 +1169,10 @@ class FedCore:
             return jax.shard_map(
                 shard_body,
                 mesh=mesh,
-                in_specs=(rep, rep, rep, rep, cl, cl, cl, cl, cl, cl,
+                in_specs=(rep, opt_spec, rep, rep, cl, cl, cl, cl, cl, cl,
                           vp_spec, sc_spec, rep) + extra_specs,
-                out_specs=(rep, rep, rep, metrics_specs, vp_spec, sc_spec),
+                out_specs=(rep, opt_spec, rep, metrics_specs, vp_spec,
+                           sc_spec),
                 axis_names=frozenset({"dp"}),
             )
 
@@ -1031,7 +1297,14 @@ class FedCore:
         )(state.params)
         return ControlState(client_controls=ci, server_control=sc)
 
-    def round_step(
+    def round_step(self, *args, **kwargs):
+        """Advance one FL round over the (placed, padded) population —
+        resolve the program variant + arguments (:meth:`_prepare_round_args`
+        holds the full parameter documentation) and launch it."""
+        fn, call_args = self._prepare_round_args(*args, **kwargs)
+        return self._launch(fn, *call_args)
+
+    def _prepare_round_args(
         self,
         state: ServerState,
         ds: ClientDataset,
@@ -1044,7 +1317,9 @@ class FedCore:
         attack_scale: Optional[jax.Array] = None,
         defense: Optional[Any] = None,
     ):
-        """Advance one FL round over the (placed, padded) population.
+        """Resolve one FL round's compiled program variant and its launch
+        arguments; ``round_step`` executes them, ``lower_round_step``
+        AOT-lowers them.
 
         ``participate`` — optional [C] 0/1 mask from the deviceflow trace
         compiler; multiplies the base weights. ``num_steps`` — optional
@@ -1128,8 +1403,8 @@ class FedCore:
                     f"variates; pass control=core.init_control(state, "
                     f"ds.num_clients)"
                 )
-            return self._launch(
-                fn, state, control, ds.x, ds.y, ds.num_samples, num_steps,
+            return fn, (
+                state, control, ds.x, ds.y, ds.num_samples, num_steps,
                 ds.client_uid, weight, jnp.float32(ds.population), *extras,
             )
         if control is not None:
@@ -1143,8 +1418,8 @@ class FedCore:
                     f"algorithm {self.algorithm.name!r} is personalized; pass "
                     f"personal=core.init_personal(state, ds.num_clients)"
                 )
-            return self._launch(
-                fn, state, personal, ds.x, ds.y, ds.num_samples, num_steps,
+            return fn, (
+                state, personal, ds.x, ds.y, ds.num_samples, num_steps,
                 ds.client_uid, weight, *extras,
             )
         if personal is not None:
@@ -1152,10 +1427,19 @@ class FedCore:
                 f"algorithm {self.algorithm.name!r} is not personalized but "
                 f"personal state was supplied"
             )
-        return self._launch(
-            fn, state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid,
+        return fn, (
+            state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid,
             weight, *extras,
         )
+
+    def lower_round_step(self, *args, **kwargs):
+        """AOT-lower the round-program variant :meth:`round_step` would
+        launch for these arguments, WITHOUT executing it. Same signature
+        as :meth:`round_step`; returns the ``jax.stages.Lowered`` (whose
+        ``.compile().as_text()`` is what ``engine/hlo_stats`` and
+        ``scripts/check_hlo_collectives.py`` analyze)."""
+        fn, call_args = self._prepare_round_args(*args, **kwargs)
+        return fn.lower(*call_args)
 
     def _launch(self, fn, *args):
         """Launch a compiled round step, counting launches and host-side

@@ -1,0 +1,101 @@
+"""Static collective analysis of compiled round programs.
+
+Parses post-optimization HLO text (``jit_fn.lower(...).compile()
+.as_text()`` — result shapes lead each instruction, e.g. ``%all-gather.1 =
+f32[8,6]{1,0} all-gather(...)``) and reports the per-device output bytes
+of every cross-replica collective. Two consumers:
+
+- ``scripts/check_hlo_collectives.py`` — the aggregation-stage memory
+  guard: fails if an ``all-gather`` whose output is at least the
+  per-client delta matrix's per-shard size (clients x params / dp bytes)
+  reappears in the defended round program (the O(clients x params)
+  replication the all_to_all sharding removed);
+- :func:`record_collective_bytes` — publishes the dominant collective per
+  kind to the ``ols_engine_collective_bytes`` gauge so bench records and
+  scraped telemetry carry the round program's ICI footprint.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+# Bytes per element for HLO primitive types (pred is storage-padded to 1).
+_ITEMSIZE = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+COLLECTIVE_OPS = (
+    "all-gather", "all-reduce", "all-to-all", "reduce-scatter",
+    "collective-permute", "collective-broadcast",
+)
+
+# `%name = <result type(s)> <op>(` where the result is one shaped type or a
+# tuple of them. Async pairs: the `-start` op's result is an
+# (operand, output, ...) context tuple — counting it would inflate bytes
+# by roughly the operand size — so async collectives are measured at their
+# `-done` op, whose result is exactly the per-device output buffer.
+_INSTR_RE = re.compile(
+    r"=\s+(\((?:[^()]|\([^()]*\))*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(" + "|".join(COLLECTIVE_OPS) + r")(-start|-done)?\("
+)
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _type_bytes(type_text: str) -> int:
+    """Bytes of one result type — a shaped type or a tuple of them."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_text):
+        if dtype not in _ITEMSIZE:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _ITEMSIZE[dtype]
+    return total
+
+
+def parse_collectives(hlo_text: str) -> List[Dict]:
+    """Every cross-replica collective in the HLO with its per-device
+    output bytes: ``[{"op": "all-gather", "bytes": 192, "type": "..."}]``.
+    Sync collectives are read directly; async pairs are read at the
+    ``-done`` op (its result IS the output buffer) and the ``-start`` half
+    is skipped."""
+    out = []
+    for m in _INSTR_RE.finditer(hlo_text):
+        if m.group(3) == "-start":
+            continue
+        out.append({
+            "op": m.group(2),
+            "bytes": _type_bytes(m.group(1)),
+            "type": m.group(1),
+        })
+    return out
+
+
+def dominant_collectives(hlo_text: str) -> Dict[str, int]:
+    """Max per-device output bytes per collective kind present."""
+    best: Dict[str, int] = {}
+    for c in parse_collectives(hlo_text):
+        best[c["op"]] = max(best.get(c["op"], 0), c["bytes"])
+    return best
+
+
+def record_collective_bytes(hlo_text: str, program: str,
+                            registry=None) -> Dict[str, int]:
+    """Publish each collective kind's dominant output bytes to the
+    ``ols_engine_collective_bytes`` gauge, labeled by (program,
+    collective); returns the same mapping."""
+    from olearning_sim_tpu.telemetry import instrument
+
+    best = dominant_collectives(hlo_text)
+    gauge = instrument("ols_engine_collective_bytes", registry)
+    for op, nbytes in best.items():
+        gauge.labels(program=program, collective=op).set(nbytes)
+    return best

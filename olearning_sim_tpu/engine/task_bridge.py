@@ -17,7 +17,9 @@ Arbitrary user code still works through the ``custom`` operator kind
     {
       "model":     {"name": "cnn4", "overrides": {...}, "input_shape": [32,32,3]},
       "algorithm": {"name": "fedavg", "local_lr": 0.05, ...},
-      "fedcore":   {"batch_size": 32, "max_local_steps": 10, "block_clients": 64},
+      "fedcore":   {"batch_size": 32, "max_local_steps": 10, "block_clients": 64,
+                    "carry_dtype": "bf16",          # bf16 local-SGD carry (validated)
+                    "shard_server_update": false},  # O(params/dp) server update
       "data":      {"synthetic": {"seed": 0, "n_local": 20, "num_classes": 10,
                     "dirichlet_alpha": null, "class_sep": 2.0}, "eval_n": 1024},
       "resilience": { ...ResilienceConfig.from_dict... },    # docs/resilience.md
@@ -148,6 +150,14 @@ def build_runner_from_taskconfig(
     equivalent task JSON."""
     if not isinstance(tc, pb.TaskConfig):
         tc = json2taskconfig(tc)
+    # Persistent XLA compilation cache: every task-bridge build (fresh
+    # submits, bench children, supervisor relaunches after a crash) shares
+    # the durable cache under artifacts/, so a relaunched or repeated
+    # variant deserializes its round programs instead of recompiling.
+    # Disable with OLS_COMPILE_CACHE=0 (docs/performance.md).
+    from olearning_sim_tpu.engine.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     plan = plan if plan is not None else make_mesh_plan()
     params = _engine_params(tc)
 
@@ -156,18 +166,10 @@ def build_runner_from_taskconfig(
     fed_cfg = params.get("fedcore", {})
     data_cfg = params.get("data", {})
 
-    personal_dtype = fed_cfg.get("personal_dtype")
-    if isinstance(personal_dtype, str):
-        import jax.numpy as jnp
-
-        personal_dtype = jnp.dtype(personal_dtype)
-    cfg = FedCoreConfig(
-        batch_size=int(fed_cfg.get("batch_size", 32)),
-        max_local_steps=int(fed_cfg.get("max_local_steps", 10)),
-        block_clients=int(fed_cfg.get("block_clients", 64)),
-        personal_dtype=personal_dtype,
-        sample_mode=fed_cfg.get("sample_mode", "auto"),
-    )
+    # One validated parser for every fedcore knob (carry_dtype included) —
+    # the submit validator (taskmgr/validation.py) runs the same from_dict,
+    # so a typo'd or wrong-typed knob fails at submit time, not mid-round.
+    cfg = FedCoreConfig.from_dict(fed_cfg)
     algorithm = algorithm_from_config(algo_cfg.pop("name", "fedavg"), **algo_cfg)
     input_shape = tuple(model_cfg.get("input_shape", [])) or None
     core = build_fedcore(

@@ -135,6 +135,14 @@ class TaskSupervisor:
         self._jobs: Dict[str, str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Relaunches re-trace the whole round-program variant grid of the
+        # resumed task; the persistent compile cache turns those into
+        # deserializes. Enabled here (not only in the default runner
+        # factory's task bridge) so custom runner factories amortize too;
+        # hit/miss counters land in the process-default registry.
+        from olearning_sim_tpu.engine.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
 
     # ----------------------------------------------------------- relaunching
     def _default_runner_factory(self, tc, stop_event):

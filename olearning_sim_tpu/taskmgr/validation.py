@@ -179,6 +179,9 @@ def validate_correctness(request) -> Tuple[bool, str]:
                         from olearning_sim_tpu.engine.defense import (
                             DefenseConfig,
                         )
+                        from olearning_sim_tpu.engine.fedcore import (
+                            FedCoreConfig,
+                        )
                         from olearning_sim_tpu.engine.pacing import (
                             DeadlineConfig,
                         )
@@ -189,6 +192,7 @@ def validate_correctness(request) -> Tuple[bool, str]:
                         for block, parse in (
                             ("deadline", DeadlineConfig.from_dict),
                             ("defense", DefenseConfig.from_dict),
+                            ("fedcore", FedCoreConfig.from_dict),
                             ("quarantine", parse_quarantine_params),
                         ):
                             if not op_params.get(block):

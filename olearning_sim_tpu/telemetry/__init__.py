@@ -142,6 +142,26 @@ CATALOG = {
         "operator preseed)",
         ("task_id",),
     ),
+    "ols_engine_compile_cache_hits_total": (
+        COUNTER,
+        "Compiled executables deserialized from the persistent XLA "
+        "compilation cache instead of recompiled (engine/compile_cache)",
+        (),
+    ),
+    "ols_engine_compile_cache_misses_total": (
+        COUNTER,
+        "Executables compiled and written to the persistent XLA "
+        "compilation cache (first compile of a round-program variant)",
+        (),
+    ),
+    "ols_engine_collective_bytes": (
+        GAUGE,
+        "Output bytes of the round program's dominant cross-replica "
+        "collective per collective kind, from the lowered/compiled HLO "
+        "(engine/hlo_stats; the aggregation-stage memory guard reads "
+        "all-gather here)",
+        ("program", "collective"),
+    ),
     # ------------------------------------------------------------ fedcore
     "ols_fedcore_round_steps_total": (
         COUNTER,

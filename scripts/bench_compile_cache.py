@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Measure the persistent-compile-cache speedup across processes.
+
+Two child processes AOT-compile the SAME defended round-program variant
+(cnn4, dp over all host devices, clip + trimmed-mean + anomaly scoring)
+against a shared cache directory. The first pays full XLA compilation and
+writes the cache entry (counted as a cache miss); the second deserializes
+it (a cache hit). Banks::
+
+    {"first": {"compile_sec": ..., "cache": {"hits": 0, "misses": N}},
+     "second": {"compile_sec": ..., "cache": {"hits": M, ...}},
+     "speedup": first/second, ...}
+
+into ``BENCH_compile_cache.json`` — the artifact behind ISSUE 6's
+">=10x second-process compile" acceptance criterion (CPU numbers are
+marked ``degraded``). Usage::
+
+    python scripts/bench_compile_cache.py             # fresh cache dir
+    python scripts/bench_compile_cache.py --keep-dir  # reuse artifacts/
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_CHILD = """
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+try:
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+except Exception:
+    pass
+from olearning_sim_tpu.engine.compile_cache import (
+    cache_stats, enable_compile_cache,
+)
+assert enable_compile_cache(sys.argv[1]), "cache must enable"
+from olearning_sim_tpu.engine import build_fedcore, fedavg
+from olearning_sim_tpu.engine.client_data import make_synthetic_dataset
+from olearning_sim_tpu.engine.defense import DefenseConfig
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+plan = make_mesh_plan()
+# Big enough that XLA compilation dominates (the second process's cost is
+# a near-constant deserialize, so the measured ratio grows with program
+# size — this shape compiles for tens of seconds on one CPU core).
+cfg = FedCoreConfig(batch_size=8, max_local_steps=5, block_clients=8,
+                    step_unroll=5)
+# cnn4 (the headline family's model): conv lowering is XLA-pass-heavy —
+# tens of seconds of compilation for a modest executable, which is the
+# realistic shape of the variant grid this cache exists for (resnet18
+# burned 377 s per BENCH_suite.json).
+core = build_fedcore("cnn4", fedavg(0.05), plan, cfg,
+                     model_overrides={"features": [16, 16, 32]},
+                     input_shape=(32, 32, 3))
+ds = make_synthetic_dataset(0, 128, 16, (32, 32, 3), 10).pad_for(
+    plan, cfg.block_clients).place(plan)
+state = core.init_state(jax.random.key(0))
+defense = DefenseConfig(clip_norm=5.0, aggregator="trimmed_mean",
+                        trim_fraction=0.1, anomaly_threshold=4.0)
+lowered = core.lower_round_step(state, ds, defense=defense)
+t0 = time.perf_counter()
+lowered.compile()
+compile_sec = time.perf_counter() - t0
+print("RESULT " + json.dumps({
+    "compile_sec": round(compile_sec, 4),
+    "backend": jax.default_backend(),
+    "chips": plan.n_devices,
+    "cache": cache_stats(),
+}), flush=True)
+"""
+
+
+def _run_child(cache_dir: str) -> dict:
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("OLS_COMPILE_CACHE", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, cache_dir],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"child failed:\n{proc.stderr[-2000:]}")
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RESULT ")][-1]
+    return json.loads(line[len("RESULT "):])
+
+
+def main() -> int:
+    if "--keep-dir" in sys.argv:
+        cache_dir = os.path.join(REPO, "artifacts", "xla_compile_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+    else:
+        cache_dir = tempfile.mkdtemp(prefix="ols_compile_cache_bench_")
+    first = _run_child(cache_dir)
+    second = _run_child(cache_dir)
+    speedup = (first["compile_sec"] / second["compile_sec"]
+               if second["compile_sec"] > 0 else float("inf"))
+    record = {
+        "captured_unix": round(time.time(), 1),
+        "backend": first["backend"],
+        "chips": first["chips"],
+        "degraded": first["backend"] != "tpu",
+        "program": "defended round step (cnn4, clip+trimmed_mean+anomaly)",
+        "first": first,
+        "second": second,
+        "speedup": round(speedup, 2),
+        "note": ("second process AOT-compiles the identical variant "
+                 "against the shared persistent cache; hits/misses from "
+                 "ols_engine_compile_cache_*_total"),
+    }
+    path = os.path.join(REPO, "BENCH_compile_cache.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, path)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

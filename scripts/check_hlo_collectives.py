@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""HLO collective lint: the defended round program must stay scale-out
+clean — no collective may re-materialize the per-client delta matrix.
+
+PR 5's robust aggregators originally ``all_gather``ed every client's
+clipped delta onto every chip (O(clients x params) per device), which caps
+the cohort size defense can survive. The engine now ``all_to_all``s the
+deltas so each chip holds all clients for 1/dp of the coordinates
+(O(clients x params / dp) peak). This lint keeps that property honest as
+*static analysis* of the real compiled artifact:
+
+1. Build the defended round program (clip + trimmed-mean + anomaly
+   scoring — the maximal defense structure) on a dp=2 CPU mesh, AOT-lower
+   and compile it, and scan the optimized HLO.
+2. FAIL if any ``all-gather`` output is at least as large as the
+   per-client delta matrix's per-shard size (clients x params_bytes / dp)
+   — the signature of the gathered formulation sneaking back in.
+3. FAIL if the program contains no ``all-to-all`` at all — the sharded
+   aggregation path silently disappearing would also pass check 2.
+
+Also publishes each collective kind's dominant output bytes to the
+``ols_engine_collective_bytes`` gauge (engine/hlo_stats), so the round
+program's ICI footprint is a scrapeable number, not a code-review guess.
+
+Runs as a tier-1 test via ``tests/test_hlo_lint.py`` and standalone:
+``python scripts/check_hlo_collectives.py`` (forces a multi-device CPU
+platform before jax initializes).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if __name__ == "__main__":
+    # Standalone: a multi-device CPU mesh must exist before jax starts.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+NUM_CLIENTS = 16
+INPUT_SHAPE = (8,)
+
+
+def build_defended_lowering(dp: int = 2, num_clients: int = NUM_CLIENTS,
+                            shard_server_update: bool = False):
+    """(compiled HLO text, params_bytes, clients) for the maximal defended
+    round program on a ``dp``-device CPU mesh."""
+    import jax
+
+    from olearning_sim_tpu.engine import build_fedcore, fedavg
+    from olearning_sim_tpu.engine.client_data import make_synthetic_dataset
+    from olearning_sim_tpu.engine.defense import DefenseConfig
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+    devices = jax.devices()
+    if len(devices) < dp:
+        raise RuntimeError(
+            f"need {dp} devices for the dp={dp} mesh, have {len(devices)} "
+            f"(set --xla_force_host_platform_device_count)"
+        )
+    plan = make_mesh_plan(devices=devices[:dp], dp=dp, mp=1)
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2,
+                        shard_server_update=shard_server_update)
+    core = build_fedcore(
+        "mlp2", fedavg(0.1), plan, cfg,
+        model_overrides={"hidden": [16], "num_classes": 3},
+        input_shape=INPUT_SHAPE,
+    )
+    ds = make_synthetic_dataset(
+        0, num_clients, 6, INPUT_SHAPE, 3
+    ).pad_for(plan, cfg.block_clients).place(plan)
+    state = core.init_state(jax.random.key(0))
+    defense = DefenseConfig(clip_norm=5.0, aggregator="trimmed_mean",
+                            trim_fraction=0.1, anomaly_threshold=4.0)
+    text = core.lower_round_step(state, ds, defense=defense) \
+        .compile().as_text()
+    params_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(state.params)
+    )
+    return text, params_bytes, ds.num_clients
+
+
+def analyze(dp: int = 2, shard_server_update: bool = False,
+            record: bool = True) -> tuple:
+    """(violations, dominant-collective bytes per kind) — one build+compile
+    serves both the guard and the summary/gauge."""
+    from olearning_sim_tpu.engine import hlo_stats
+
+    text, params_bytes, clients = build_defended_lowering(
+        dp=dp, shard_server_update=shard_server_update
+    )
+    threshold = clients * params_bytes // dp
+    problems = []
+    collectives = hlo_stats.parse_collectives(text)
+    for c in collectives:
+        if c["op"] == "all-gather" and c["bytes"] >= threshold:
+            problems.append(
+                f"defended round program (dp={dp}) all-gathers "
+                f"{c['bytes']} bytes ({c['type']}) >= the per-client delta "
+                f"matrix shard threshold of {threshold} bytes "
+                f"({clients} clients x {params_bytes} param bytes / "
+                f"dp={dp}) — the O(clients x params) gathered aggregation "
+                f"must not return; use the all_to_all sharded path "
+                f"(engine/defense.py)"
+            )
+    if not any(c["op"] == "all-to-all" for c in collectives):
+        problems.append(
+            f"defended round program (dp={dp}) contains no all-to-all: "
+            f"the sharded robust-aggregation path is missing entirely"
+        )
+    if record:
+        hlo_stats.record_collective_bytes(
+            text, program="defended_round"
+        )
+    return problems, hlo_stats.dominant_collectives(text)
+
+
+def check(dp: int = 2, shard_server_update: bool = False,
+          record: bool = True) -> list:
+    """Returns the list of violations (empty = clean)."""
+    return analyze(dp=dp, shard_server_update=shard_server_update,
+                   record=record)[0]
+
+
+def main() -> int:
+    problems, best = analyze()
+    for p in problems:
+        print(f"check_hlo_collectives: {p}", file=sys.stderr)
+    if problems:
+        print(f"check_hlo_collectives: {len(problems)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_hlo_collectives: OK — dominant collectives "
+          + ", ".join(f"{k}={v}B" for k, v in sorted(best.items())))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
