@@ -13,6 +13,7 @@ from olearning_sim_tpu.config import build_session
 from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
 from olearning_sim_tpu.taskmgr.grpc_service import TaskMgrClient
 from olearning_sim_tpu.taskmgr.status import TaskStatus
+from olearning_sim_tpu.utils.clocks import Deadline
 
 
 def make_task(task_id: str) -> dict:
@@ -92,8 +93,10 @@ def main():
             tc = json2taskconfig(json.dumps(make_task("example-task")))
             status = client.submitTask(tc)
             print("submitTask:", status.is_success)
-            deadline = time.time() + 120
-            while time.time() < deadline:
+            # Monotonic countdown: immune to NTP/wall-clock steps
+            # (utils.clocks is the platform's one timeout clock).
+            deadline = Deadline(120.0)
+            while not deadline.expired():
                 st = TaskStatus(client.getTaskStatus("example-task").taskStatus)
                 print("status:", st.name)
                 if st in (TaskStatus.SUCCEEDED, TaskStatus.FAILED):

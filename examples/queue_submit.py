@@ -12,6 +12,7 @@ import time
 from olearning_sim_tpu.config import build_session
 from olearning_sim_tpu.taskmgr.queue_repo import SqliteQueueRepo
 from olearning_sim_tpu.taskmgr.status import TaskStatus
+from olearning_sim_tpu.utils.clocks import Deadline
 
 from platform_submit import make_task
 
@@ -37,8 +38,10 @@ def main():
                           "speedup": 1000.0},
         })
         with session:
-            deadline = time.time() + 120
-            while time.time() < deadline:
+            # Monotonic countdown: immune to NTP/wall-clock steps
+            # (utils.clocks is the platform's one timeout clock).
+            deadline = Deadline(120.0)
+            while not deadline.expired():
                 st = session.task_manager.get_task_status("queued-task")
                 print("status:", st.name)
                 if st in (TaskStatus.SUCCEEDED, TaskStatus.FAILED):

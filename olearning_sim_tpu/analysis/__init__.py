@@ -1,0 +1,69 @@
+"""Program-analysis suite: static guarantees over the compiled artifact.
+
+The platform's core bet is that every behavior — deadline masks, attack
+injection, robust aggregation, the sharded server update — compiles into
+ONE XLA round program, so the compiled artifact (not the Python) is where
+scale regressions hide. This package analyzes that artifact, plus the
+repo's source, as *checks*:
+
+- :mod:`~olearning_sim_tpu.analysis.grid` — the variant grid: every
+  (program x shard_server_update x dp) combination AOT-lowered and
+  compiled once per process, shared by the analyzers below.
+- :mod:`~olearning_sim_tpu.analysis.hlo_audit` — per-variant budgets:
+  collective bytes per kind, largest live buffer, dtype census (f64
+  leakage), donation survival; diffed against the checked-in golden
+  ``analysis/budgets.json``.
+- :mod:`~olearning_sim_tpu.analysis.retrace` — the no-retrace guarantee:
+  per-round scalar knobs (clip, deadline, attack scale, trim fraction)
+  are data, never baked constants — one executable per variant.
+- :mod:`~olearning_sim_tpu.analysis.ast_rules` — repo-invariant AST
+  lints: wall-clock discipline, sqlite access routing, host-sync-free
+  engine, no invisible exception swallows.
+
+``scripts/check_all.py`` drives all of these (plus the four pre-existing
+check scripts) under uniform exit codes and a JSON report; each module
+also runs standalone via ``python -m olearning_sim_tpu.analysis.<mod>``.
+See docs/static_analysis.md for the analyzer catalog, the budget
+re-bless workflow, and the waiver policy.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+
+def run_analyzers(
+    registry: Dict[str, Callable[[], List[str]]],
+    only: Optional[List[str]] = None,
+    skip: Optional[List[str]] = None,
+) -> Dict[str, Dict]:
+    """Run each ``name -> check()`` analyzer, timing it and catching
+    internal errors, into a uniform report::
+
+        {name: {"ok": bool, "problems": [...], "seconds": float,
+                "error": str | None}}
+
+    ``ok`` is False for both findings and crashes; ``error`` is set only
+    when the analyzer itself raised (exit code 2 territory for drivers).
+    """
+    report: Dict[str, Dict] = {}
+    for name, fn in registry.items():
+        if only is not None and name not in only:
+            continue
+        if skip is not None and name in skip:
+            continue
+        t0 = time.perf_counter()
+        problems: List[str] = []
+        error = None
+        try:
+            problems = list(fn())
+        except Exception as e:  # noqa: BLE001 — a crashed analyzer is a report entry
+            error = f"{type(e).__name__}: {e}"
+        report[name] = {
+            "ok": error is None and not problems,
+            "problems": problems,
+            "seconds": round(time.perf_counter() - t0, 3),
+            "error": error,
+        }
+    return report

@@ -1,0 +1,218 @@
+"""The round-program variant grid: every compiled artifact the engine can
+produce, AOT-lowered and compiled ONCE per process for the analyzers.
+
+One :class:`Variant` names a point in (program structure x
+``shard_server_update`` x dp). For each, :func:`artifacts` builds a tiny
+fedcore (mlp2, 16 clients — shapes small enough that the whole grid
+compiles in tens of seconds on CPU, structure identical to production
+programs) and captures:
+
+- ``lowered_a`` / ``lowered_b`` — the StableHLO of two
+  ``FedCore.lower_round_step`` calls with DIFFERENT per-round scalar-knob
+  values (clip finite vs disabled, deadline, trim fraction, attack
+  scales). Identical text proves the knobs are data, not baked
+  constants (analysis/retrace).
+- ``same_fn`` / ``trace_count`` — the two knob settings resolved to the
+  same compiled-function variant and traced it exactly once (the
+  executable-cache-key half of the no-retrace guarantee; PR 5's
+  literal-inf clip bug re-keyed exactly this cache).
+- ``compiled`` — post-optimization HLO of the first lowering, plus
+  ``memory`` stats (analysis/hlo_audit budgets).
+
+Builds are cached process-wide so hlo_audit, retrace, and
+check_hlo_collectives share one compile per variant (a full-grid run in
+``scripts/check_all.py`` compiles each program exactly once).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
+
+PROGRAMS = ("plain", "deadline", "attack", "defense", "maximal")
+
+NUM_CLIENTS = 16
+INPUT_SHAPE = (8,)
+NUM_CLASSES = 3
+MODEL = "mlp2"
+MODEL_OVERRIDES = {"hidden": [16], "num_classes": NUM_CLASSES}
+
+
+@dataclasses.dataclass(frozen=True)
+class Variant:
+    """One point of the grid; ``name`` keys budgets.json."""
+
+    program: str          # one of PROGRAMS
+    shard_server_update: bool
+    dp: int
+
+    @property
+    def name(self) -> str:
+        return (f"{self.program}/shard{int(self.shard_server_update)}"
+                f"/dp{self.dp}")
+
+
+def variant_grid(dps: Tuple[int, ...] = (1, 2),
+                 programs: Iterable[str] = PROGRAMS) -> List[Variant]:
+    """The full audit grid: programs x shard_server_update x dp."""
+    return [
+        Variant(program=p, shard_server_update=s, dp=dp)
+        for p in programs
+        for s in (False, True)
+        for dp in dps
+    ]
+
+
+_CORES: Dict[Tuple[bool, int], tuple] = {}
+_ARTIFACTS: Dict[str, Dict] = {}
+
+
+def _core_state_ds(shard: bool, dp: int):
+    """A (core, state, dataset) triple per (shard_server_update, dp),
+    cached — every program variant of that pair reuses one build."""
+    key = (shard, dp)
+    if key in _CORES:
+        return _CORES[key]
+    import jax
+
+    from olearning_sim_tpu.engine import build_fedcore, fedavg
+    from olearning_sim_tpu.engine.client_data import make_synthetic_dataset
+    from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+    from olearning_sim_tpu.parallel.mesh import make_mesh_plan
+
+    devices = jax.devices()
+    if len(devices) < dp:
+        raise RuntimeError(
+            f"variant grid needs {dp} devices, have {len(devices)}; set "
+            f"--xla_force_host_platform_device_count (conftest/check_all "
+            f"do this before jax initializes)"
+        )
+    plan = make_mesh_plan(devices=devices[:dp], dp=dp, mp=1)
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2,
+                        shard_server_update=shard)
+    core = build_fedcore(
+        MODEL, fedavg(0.1), plan, cfg,
+        model_overrides=dict(MODEL_OVERRIDES), input_shape=INPUT_SHAPE,
+    )
+    ds = make_synthetic_dataset(
+        0, NUM_CLIENTS, 6, INPUT_SHAPE, NUM_CLASSES
+    ).pad_for(plan, cfg.block_clients).place(plan)
+    state = core.init_state(jax.random.key(0))
+    _CORES[key] = (core, state, ds)
+    return _CORES[key]
+
+
+def _knob_kwargs(program: str, core, ds, setting: str) -> Dict:
+    """round_step kwargs for knob setting "a" or "b" of one program.
+    The two settings differ in EVERY per-round scalar knob the variant
+    exposes — including clip finite-vs-disabled, the exact transition
+    that once re-keyed the executable cache (fedcore.py sentinel note)."""
+    import numpy as np
+
+    from olearning_sim_tpu.engine.defense import DefenseConfig
+    from olearning_sim_tpu.parallel.mesh import global_put
+
+    b = setting == "b"
+    kwargs: Dict = {}
+    if program in ("deadline", "maximal"):
+        completion = np.linspace(
+            0.2, 3.0 if not b else 9.0, ds.num_clients
+        ).astype(np.float32)
+        kwargs["completion_time"] = global_put(
+            completion, core.plan.client_sharding()
+        )
+        kwargs["deadline"] = 1.75 if not b else 0.5
+    if program in ("attack", "maximal"):
+        scale = np.ones((ds.num_clients,), np.float32)
+        scale[: ds.num_clients // 4] = -1.0 if not b else 7.5
+        kwargs["attack_scale"] = global_put(
+            scale, core.plan.client_sharding()
+        )
+    if program in ("defense", "maximal"):
+        kwargs["defense"] = DefenseConfig(
+            clip_norm=5.0 if not b else None,  # None = disabled sentinel
+            aggregator="trimmed_mean",
+            trim_fraction=0.1 if not b else 0.4,
+            anomaly_threshold=4.0,
+        )
+    return kwargs
+
+
+def artifacts(variant: Variant) -> Dict:
+    """Lowered/compiled artifacts for one variant (process-cached)."""
+    if variant.name in _ARTIFACTS:
+        return _ARTIFACTS[variant.name]
+    import jax
+
+    core, state, ds = _core_state_ds(variant.shard_server_update, variant.dp)
+
+    kwargs_a = _knob_kwargs(variant.program, core, ds, "a")
+    fn_a, args_a = core._prepare_round_args(state, ds, **kwargs_a)
+    fn_b, args_b = core._prepare_round_args(
+        state, ds, **_knob_kwargs(variant.program, core, ds, "b")
+    )
+    lowered = fn_a.lower(*args_a)
+    lowered_b = fn_b.lower(*args_b)
+    # The trace-count probe: mirror _prepare_round_args' variant key and
+    # read how many times this variant's body was traced — 1 iff the
+    # second knob setting hit the cached trace (the executable-cache-key
+    # guarantee; a retrace would bump it to 2).
+    key = (
+        "deadline" in kwargs_a, "attack_scale" in kwargs_a,
+        kwargs_a["defense"].structure_key
+        if "defense" in kwargs_a else None,
+    )
+    trace_count = core.trace_counts.get(key, 0)
+
+    compiled = lowered.compile()
+    compiled_text = compiled.as_text()
+    try:
+        mem = compiled.memory_analysis()
+        memory = {
+            "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+            "argument_bytes": int(getattr(mem, "argument_size_in_bytes", 0)),
+            "output_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+            "alias_bytes": int(getattr(mem, "alias_size_in_bytes", 0)),
+        }
+    except Exception:  # noqa: BLE001 — memory stats are best-effort per backend
+        memory = None
+
+    params_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(state.params)
+    )
+    art = {
+        "variant": variant.name,
+        "program": variant.program,
+        "dp": variant.dp,
+        "shard_server_update": variant.shard_server_update,
+        "lowered_a": lowered.as_text(),
+        "lowered_b": lowered_b.as_text(),
+        "same_fn": fn_a is fn_b,
+        "trace_count": trace_count,
+        "compiled": compiled_text,
+        "memory": memory,
+        "params_bytes": params_bytes,
+        "clients": ds.num_clients,
+    }
+    _ARTIFACTS[variant.name] = art
+    return art
+
+
+def grid_artifacts(
+    variants: Optional[List[Variant]] = None,
+    progress=None,
+) -> Dict[str, Dict]:
+    """Artifacts for the whole grid, keyed by variant name."""
+    out = {}
+    for v in variants if variants is not None else variant_grid():
+        if progress is not None:
+            progress(v.name)
+        out[v.name] = artifacts(v)
+    return out
+
+
+def reset_cache() -> None:
+    """Drop cached cores/artifacts (tests that fork platform config)."""
+    _CORES.clear()
+    _ARTIFACTS.clear()

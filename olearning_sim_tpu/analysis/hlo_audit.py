@@ -1,0 +1,249 @@
+"""HLO budget audit: every round-program variant's compiled artifact must
+match the checked-in golden budgets.
+
+For each grid variant (analysis/grid), the audit measures from the REAL
+compiled HLO (never the Python):
+
+- **collectives** — dominant per-device output bytes per collective kind.
+  A new kind appearing, a kind disappearing, or bytes growing past the
+  tolerance fails: this is how the O(clients x params) all-gather class of
+  regression (PR 6) is caught grid-wide, not just on the one defended
+  program ``check_hlo_collectives`` pins.
+- **largest_buffer_bytes** — the biggest single instruction result the
+  program materializes. A silent return of a clients x params buffer (or
+  an accidental full-matrix intermediate) shows up here.
+- **dtypes** — the census of result element types. ``f64`` anywhere is a
+  precision leak (default-f32 jax; a stray Python double crossed the jit
+  boundary) and always fails; any other NEW dtype fails against golden.
+- **donated_inputs / aliased_outputs** — ``donate_argnums`` donations in
+  ``fedcore.py`` must survive lowering (``jax.buffer_donor`` /
+  ``tf.aliasing_output`` arg attributes) AND compilation (the module
+  header's ``input_output_alias`` table). A lost donation doubles peak
+  param memory at scale and fails exactly.
+
+Budgets live in ``analysis/budgets.json`` — regenerate with
+``python scripts/check_all.py --bless`` (or ``python -m
+olearning_sim_tpu.analysis.hlo_audit --bless``) after an INTENTIONAL
+program change, and commit the diff; docs/static_analysis.md documents
+the workflow. Tolerances are per-file ``tolerances`` ratios (and
+per-variant overrides under a variant's ``"tolerances"`` key): measured
+bytes may not exceed golden x ratio. ``memory`` stats are recorded for
+operators but not enforced (CPU/TPU buffer assignment differs too much
+across jaxlib versions to pin).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from olearning_sim_tpu.engine import hlo_stats
+
+BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "budgets.json")
+
+# Measured value may not exceed golden * ratio. Collective bytes are pure
+# shape math (exact); the largest buffer can drift with XLA fusion
+# decisions across versions, so it gets headroom.
+DEFAULT_TOLERANCES = {
+    "collective_bytes": 1.0,
+    "largest_buffer_bytes": 1.25,
+}
+
+
+def measure(art: Dict) -> Dict:
+    """The budgetable facts of one variant's artifacts (grid.artifacts)."""
+    compiled = art["compiled"]
+    lowered = art["lowered_a"]
+    largest = hlo_stats.largest_result(compiled)
+    return {
+        "collectives": hlo_stats.dominant_collectives(compiled),
+        "largest_buffer_bytes": largest["bytes"] if largest else 0,
+        "largest_buffer_op": largest["op"] if largest else None,
+        "dtypes": sorted(hlo_stats.dtype_census(compiled)),
+        "donated_inputs": hlo_stats.count_donated_inputs(lowered),
+        "aliased_outputs": len(
+            hlo_stats.parse_input_output_aliases(compiled)
+        ),
+        "params_bytes": art["params_bytes"],
+        "clients": art["clients"],
+        "memory": art.get("memory"),
+    }
+
+
+def compare(name: str, measured: Dict, golden: Dict,
+            tolerances: Optional[Dict] = None) -> List[str]:
+    """Findings for one variant: measured vs its golden budget entry."""
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(tolerances or {})
+    tol.update(golden.get("tolerances") or {})
+    problems = []
+
+    if "f64" in measured["dtypes"] and not golden.get("allow_f64"):
+        problems.append(
+            f"{name}: f64 appears in the compiled program (dtype census "
+            f"{measured['dtypes']}) — a Python double leaked across the "
+            f"jit boundary (precision + 2x memory regression)"
+        )
+    new_dtypes = set(measured["dtypes"]) - set(golden.get("dtypes", []))
+    new_dtypes.discard("f64")  # already reported above, more precisely
+    if new_dtypes:
+        problems.append(
+            f"{name}: new dtypes {sorted(new_dtypes)} in the compiled "
+            f"program (golden census: {golden.get('dtypes')}); re-bless "
+            f"if intentional"
+        )
+
+    g_coll = golden.get("collectives", {})
+    m_coll = measured["collectives"]
+    for kind in sorted(set(m_coll) - set(g_coll)):
+        problems.append(
+            f"{name}: new collective kind {kind!r} "
+            f"({m_coll[kind]} bytes/device) not in the golden budget — "
+            f"the program's communication shape changed; re-bless if "
+            f"intentional"
+        )
+    for kind in sorted(set(g_coll) - set(m_coll)):
+        problems.append(
+            f"{name}: collective {kind!r} disappeared from the compiled "
+            f"program (golden: {g_coll[kind]} bytes/device) — a sharded "
+            f"path silently vanishing also passes byte checks, so this "
+            f"fails loudly"
+        )
+    ratio = tol["collective_bytes"]
+    for kind in sorted(set(g_coll) & set(m_coll)):
+        if m_coll[kind] > g_coll[kind] * ratio:
+            problems.append(
+                f"{name}: {kind} grew to {m_coll[kind]} bytes/device "
+                f"(golden {g_coll[kind]}, tolerance x{ratio}) — collective "
+                f"bytes are shape math, so this is a real layout change"
+            )
+
+    g_big = golden.get("largest_buffer_bytes", 0)
+    if measured["largest_buffer_bytes"] > g_big * tol["largest_buffer_bytes"]:
+        problems.append(
+            f"{name}: largest live buffer grew to "
+            f"{measured['largest_buffer_bytes']} bytes "
+            f"({measured['largest_buffer_op']}; golden {g_big}, tolerance "
+            f"x{tol['largest_buffer_bytes']}) — check for a rematerialized "
+            f"clients x params intermediate"
+        )
+
+    for field, label in (("donated_inputs", "lowered donation markers"),
+                         ("aliased_outputs",
+                          "compiled input-output aliases")):
+        if measured[field] != golden.get(field, 0):
+            problems.append(
+                f"{name}: {label} changed: {measured[field]} vs golden "
+                f"{golden.get(field, 0)} — a lost donation doubles peak "
+                f"param memory; a gained one should be blessed"
+            )
+    return problems
+
+
+def load_budgets(path: Optional[str] = None) -> Dict:
+    with open(path or BUDGETS_PATH, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def check(artifacts_by_name: Optional[Dict[str, Dict]] = None,
+          budgets: Optional[Dict] = None,
+          budgets_path: Optional[str] = None) -> List[str]:
+    """Audit the grid against budgets; returns findings (empty = clean)."""
+    from olearning_sim_tpu.analysis import grid
+
+    if budgets is None:
+        try:
+            budgets = load_budgets(budgets_path)
+        except OSError as e:
+            return [
+                f"cannot read golden budgets ({e}); generate with "
+                f"`python scripts/check_all.py --bless`"
+            ]
+    if artifacts_by_name is None:
+        artifacts_by_name = grid.grid_artifacts()
+
+    tolerances = budgets.get("tolerances")
+    entries = budgets.get("variants", {})
+    problems: List[str] = []
+    for name, art in sorted(artifacts_by_name.items()):
+        golden = entries.get(name)
+        if golden is None:
+            problems.append(
+                f"{name}: variant missing from budgets.json — bless the "
+                f"grid (`python scripts/check_all.py --bless`)"
+            )
+            continue
+        problems.extend(compare(name, measure(art), golden, tolerances))
+    for stale in sorted(set(entries) - set(artifacts_by_name)):
+        problems.append(
+            f"{stale}: budget entry no longer in the variant grid — "
+            f"remove it (re-bless)"
+        )
+    return problems
+
+
+def bless(artifacts_by_name: Optional[Dict[str, Dict]] = None,
+          path: Optional[str] = None) -> Dict:
+    """Measure the grid and (re)write the golden budgets file."""
+    from olearning_sim_tpu.analysis import grid
+
+    if artifacts_by_name is None:
+        artifacts_by_name = grid.grid_artifacts()
+
+    def entry(art):
+        # The golden holds only ENFORCED facts: memory_analysis numbers
+        # are backend/jaxlib-volatile and would churn every re-bless diff
+        # (they still ride the check_all --json report via measure()).
+        m = measure(art)
+        m.pop("memory", None)
+        return m
+
+    budgets = {
+        "_comment": (
+            "Golden HLO budgets per round-program variant. Regenerate "
+            "with `python scripts/check_all.py --bless` after an "
+            "intentional program change and commit the diff "
+            "(docs/static_analysis.md)."
+        ),
+        "tolerances": dict(DEFAULT_TOLERANCES),
+        "variants": {
+            name: entry(art)
+            for name, art in sorted(artifacts_by_name.items())
+        },
+    }
+    out = path or BUDGETS_PATH
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(budgets, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return budgets
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--bless" in argv:
+        budgets = bless()
+        print(f"hlo_audit: blessed {len(budgets['variants'])} variants "
+              f"-> {BUDGETS_PATH}")
+        return 0
+    problems = check()
+    for p in problems:
+        print(f"hlo_audit: {p}", file=sys.stderr)
+    if problems:
+        print(f"hlo_audit: {len(problems)} violation(s)", file=sys.stderr)
+        return 1
+    print("hlo_audit: OK — grid within budgets")
+    return 0
+
+
+if __name__ == "__main__":
+    # Standalone: a multi-device CPU platform must exist before jax init.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.exit(main())

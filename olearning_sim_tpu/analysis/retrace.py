@@ -1,0 +1,108 @@
+"""Retrace / constant-leak detector: per-round scalar knobs must be data.
+
+PR 5 learned this the hard way: a literal ``inf`` clip value re-keyed the
+jit executable cache, so toggling clipping recompiled the round program
+(seconds to minutes, per toggle, silently). The fix made every scalar
+knob — clip norm, trim fraction, deadline, attack scales — a traced
+input, asserted by a one-off ``FedCore.trace_counts`` probe on the one
+defended program. This analyzer generalizes that probe to the WHOLE
+variant grid as a static check:
+
+For every variant, analysis/grid resolves and AOT-lowers the round
+program twice with different knob values (clip 5.0 vs disabled, deadline
+1.75 vs 0.5, trim 0.1 vs 0.4, attack scales -1 vs 7.5). The guarantee
+has three layers, each failing independently:
+
+1. **Same compiled function** — both knob settings must resolve to the
+   same ``_round_step_variants`` cache entry; a knob leaking into the
+   variant KEY means every value change rebuilds the program.
+2. **One trace** — ``trace_counts`` for the variant stays at 1 after both
+   lowerings; a second trace means jax saw different avals (the
+   executable-cache-key regression: e.g. a weak-typed Python scalar
+   changing type between rounds).
+3. **Identical lowering** — the two StableHLO texts must be byte-equal; a
+   knob baked as ``stablehlo.constant`` produces a textual diff even when
+   the avals happen to agree.
+
+Standalone: ``python -m olearning_sim_tpu.analysis.retrace``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def _first_diff(a: str, b: str, context: int = 1) -> str:
+    """A one-line pointer at the first differing line (for findings)."""
+    for i, (la, lb) in enumerate(itertools.zip_longest(
+            a.splitlines(), b.splitlines(), fillvalue="<eof>")):
+        if la != lb:
+            marker = ""
+            if "constant" in la or "constant" in lb:
+                marker = " (a baked constant — the knob is compile-time)"
+            return (f"first diff at lowered line {i + 1}{marker}: "
+                    f"{la.strip()[:120]!r} vs {lb.strip()[:120]!r}")
+    return "texts differ only in length"
+
+
+def compare_variant(art: Dict) -> List[str]:
+    """Findings for one variant's grid artifacts (empty = clean)."""
+    name = art["variant"]
+    problems = []
+    if not art["same_fn"]:
+        problems.append(
+            f"{name}: the two knob settings resolved to DIFFERENT "
+            f"compiled functions — a per-round scalar knob is part of the "
+            f"program-variant key (every value change rebuilds the "
+            f"program; keep knobs out of _round_step_variants keys)"
+        )
+    if art["trace_count"] != 1:
+        problems.append(
+            f"{name}: round program traced {art['trace_count']} times "
+            f"across two knob settings (must be exactly 1) — the jit "
+            f"executable cache was re-keyed; check that every scalar knob "
+            f"enters as a committed jnp array, not a Python literal"
+        )
+    if art["lowered_a"] != art["lowered_b"]:
+        problems.append(
+            f"{name}: lowered programs differ between knob settings — a "
+            f"knob was baked into the traced program as a constant; "
+            f"{_first_diff(art['lowered_a'], art['lowered_b'])}"
+        )
+    return problems
+
+
+def check(artifacts_by_name: Optional[Dict[str, Dict]] = None) -> List[str]:
+    """Retrace findings across the whole grid (empty = clean)."""
+    from olearning_sim_tpu.analysis import grid
+
+    if artifacts_by_name is None:
+        artifacts_by_name = grid.grid_artifacts()
+    problems: List[str] = []
+    for _, art in sorted(artifacts_by_name.items()):
+        problems.extend(compare_variant(art))
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    problems = check()
+    for p in problems:
+        print(f"retrace: {p}", file=sys.stderr)
+    if problems:
+        print(f"retrace: {len(problems)} violation(s)", file=sys.stderr)
+        return 1
+    print("retrace: OK — one executable per variant across knob settings")
+    return 0
+
+
+if __name__ == "__main__":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.exit(main())

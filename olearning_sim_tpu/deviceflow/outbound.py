@@ -17,8 +17,11 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 
 def _encode(msg: Any) -> str:
@@ -47,7 +50,9 @@ class WebsocketProducer:
             self._connect()
         try:
             self._ws.send(frame)
-        except Exception:
+        except Exception as e:
+            logger.debug("websocket send to %s failed (%s: %s); "
+                         "reconnecting once", self.url, type(e).__name__, e)
             self.close()
             self._connect()
             self._ws.send(frame)
@@ -63,8 +68,11 @@ class WebsocketProducer:
         if ws is not None:
             try:
                 ws.close()
-            except Exception:
-                pass
+            except Exception as e:
+                # Best-effort teardown of a possibly-dead socket, but the
+                # failure stays observable for degraded-path debugging.
+                logger.debug("websocket close for %s failed: %s: %s",
+                             self.url, type(e).__name__, e)
 
 
 class GrpcOutboundProducer:

@@ -77,7 +77,7 @@ def _platform_hint() -> str:
         import jax
 
         plat = getattr(jax.config, "jax_platforms", None) or plat
-    except Exception:  # noqa: BLE001 — env answer is good enough
+    except Exception:  # lint: allow-silent — env answer is good enough
         pass
     return str(plat).split(",")[0].strip()
 
@@ -161,8 +161,8 @@ def _install_listener() -> None:
                 instrument("ols_engine_compile_cache_hits_total").inc()
             elif event == "/jax/compilation_cache/cache_misses":
                 instrument("ols_engine_compile_cache_misses_total").inc()
-        except Exception:  # noqa: BLE001 — telemetry must never break compiles
-            pass
+        except Exception:  # lint: allow-silent — telemetry must never
+            pass           # break compiles
 
     try:
         monitoring.register_event_listener(_on_event)

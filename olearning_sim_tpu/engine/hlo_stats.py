@@ -1,32 +1,48 @@
-"""Static collective analysis of compiled round programs.
+"""Static analysis of compiled round programs' HLO text.
 
 Parses post-optimization HLO text (``jit_fn.lower(...).compile()
 .as_text()`` — result shapes lead each instruction, e.g. ``%all-gather.1 =
-f32[8,6]{1,0} all-gather(...)``) and reports the per-device output bytes
-of every cross-replica collective. Two consumers:
+f32[8,6]{1,0} all-gather(...)``) into a general instruction walk. Three
+consumers:
 
 - ``scripts/check_hlo_collectives.py`` — the aggregation-stage memory
   guard: fails if an ``all-gather`` whose output is at least the
   per-client delta matrix's per-shard size (clients x params / dp bytes)
   reappears in the defended round program (the O(clients x params)
   replication the all_to_all sharding removed);
+- ``olearning_sim_tpu/analysis/hlo_audit.py`` — the per-variant budget
+  audit: collective bytes per kind, largest live result buffer, dtype
+  census (f64 leakage), and input-output aliasing (donation survival);
 - :func:`record_collective_bytes` — publishes the dominant collective per
   kind to the ``ols_engine_collective_bytes`` gauge so bench records and
   scraped telemetry carry the round program's ICI footprint.
+
+Sizes are computed in BITS then rounded up to bytes per array, so
+sub-byte dtypes (``s4``/``u4``) count their packed storage, not zero.
+Result types may be scalars (``f32[]``), ``token[]``, or tuples whose
+elements carry layouts (``(f32[4,3]{1,0:T(8,128)}, token[])``).
 """
 
 from __future__ import annotations
 
+import math
 import re
-from typing import Dict, List
+from typing import Dict, List, Optional
 
-# Bytes per element for HLO primitive types (pred is storage-padded to 1).
-_ITEMSIZE = {
-    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
-    "c128": 16,
+# Bits per element for HLO primitive types. ``pred`` is storage-padded to
+# a byte; sub-byte ints (s4/u4, s2/u2) pack 2-4 per byte; ``token`` and
+# ``opaque`` occupy no addressable buffer.
+_ITEMBITS = {
+    "pred": 8,
+    "s2": 2, "u2": 2, "s4": 4, "u4": 4,
+    "s8": 8, "u8": 8,
+    "f8e3m4": 8, "f8e4m3": 8, "f8e4m3fn": 8, "f8e4m3b11fnuz": 8,
+    "f8e4m3fnuz": 8, "f8e5m2": 8, "f8e5m2fnuz": 8,
+    "s16": 16, "u16": 16, "f16": 16, "bf16": 16,
+    "s32": 32, "u32": 32, "f32": 32,
+    "s64": 64, "u64": 64, "f64": 64, "c64": 64,
+    "c128": 128,
+    "token": 0, "opaque": 0,
 }
 
 COLLECTIVE_OPS = (
@@ -34,31 +50,77 @@ COLLECTIVE_OPS = (
     "collective-permute", "collective-broadcast",
 )
 
-# `%name = <result type(s)> <op>(` where the result is one shaped type or a
-# tuple of them. Async pairs: the `-start` op's result is an
-# (operand, output, ...) context tuple — counting it would inflate bytes
-# by roughly the operand size — so async collectives are measured at their
-# `-done` op, whose result is exactly the per-device output buffer.
+# One instruction result: `%name = <type> <op>(...`. The type is a single
+# shaped type (optionally with a layout, whose tile annotation may nest one
+# level of parens: `{1,0:T(8,128)}`) or a tuple of such types.
+_TYPE_FRAGMENT = (
+    r"\((?:[^()]|\([^()]*\))*\)"          # tuple (one nested paren level)
+    r"|[a-z][a-z0-9]*\[[^\]]*\](?:\{[^}]*\})?"  # shaped type [+ layout]
+)
 _INSTR_RE = re.compile(
-    r"=\s+(\((?:[^()]|\([^()]*\))*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
-    r"(" + "|".join(COLLECTIVE_OPS) + r")(-start|-done)?\("
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(" + _TYPE_FRAGMENT + r")\s+"
+    r"([a-z][a-z0-9\-]*)\(",
+    re.MULTILINE,
 )
 
-_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+# Shaped types inside a result type. Dims are digit lists; bounded-dynamic
+# dims (`<=8`) count their bound.
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,<=\s]*)\]")
+
+# One entry of the HloModule header's `input_output_alias={ ... }`:
+# `{output-index}: (param, {param-index}, may-alias|must-alias)`.
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9,\s]*)\}:\s*\(([0-9]+),\s*\{[0-9,\s]*\}"
+    r"(?:,\s*(may-alias|must-alias))?\)"
+)
 
 
 def _type_bytes(type_text: str) -> int:
-    """Bytes of one result type — a shaped type or a tuple of them."""
+    """Bytes of one result type — a shaped type or a tuple of them.
+    Each array is sized in bits and rounded up to whole bytes (so
+    ``u4[7]`` is 4 bytes: 7 nibbles packed two-per-byte)."""
     total = 0
     for dtype, dims in _SHAPE_RE.findall(type_text):
-        if dtype not in _ITEMSIZE:
+        if dtype not in _ITEMBITS:
             continue
         n = 1
         for d in dims.split(","):
+            d = d.strip().lstrip("<=")
             if d:
                 n *= int(d)
-        total += n * _ITEMSIZE[dtype]
+        total += math.ceil(n * _ITEMBITS[dtype] / 8)
     return total
+
+
+def _result_dtypes(type_text: str) -> List[str]:
+    """Element dtypes present in one result type (tuples contribute each
+    element; layout text never matches the shape regex)."""
+    return [d for d, _ in _SHAPE_RE.findall(type_text)
+            if d in _ITEMBITS and _ITEMBITS[d] > 0]
+
+
+def parse_instructions(hlo_text: str) -> List[Dict]:
+    """Every instruction in the HLO with its opcode, result type text, and
+    result-buffer bytes: ``[{"op": "fusion", "bytes": 192, "type": ...}]``.
+    Works on both optimized HLO and any text whose instructions follow the
+    ``%name = <type> op(`` form."""
+    out = []
+    for m in _INSTR_RE.finditer(hlo_text):
+        out.append({
+            "op": m.group(2),
+            "bytes": _type_bytes(m.group(1)),
+            "type": m.group(1),
+        })
+    return out
+
+
+def _split_async(op: str):
+    """``all-gather-start`` -> ("all-gather", "-start"); sync ops get
+    ("op", None)."""
+    for suffix in ("-start", "-done"):
+        if op.endswith(suffix):
+            return op[: -len(suffix)], suffix
+    return op, None
 
 
 def parse_collectives(hlo_text: str) -> List[Dict]:
@@ -66,16 +128,14 @@ def parse_collectives(hlo_text: str) -> List[Dict]:
     output bytes: ``[{"op": "all-gather", "bytes": 192, "type": "..."}]``.
     Sync collectives are read directly; async pairs are read at the
     ``-done`` op (its result IS the output buffer) and the ``-start`` half
-    is skipped."""
+    is skipped — the start op's result is an (operand, output, ...) context
+    tuple whose size would inflate bytes by roughly the operand size."""
     out = []
-    for m in _INSTR_RE.finditer(hlo_text):
-        if m.group(3) == "-start":
+    for ins in parse_instructions(hlo_text):
+        base, suffix = _split_async(ins["op"])
+        if base not in COLLECTIVE_OPS or suffix == "-start":
             continue
-        out.append({
-            "op": m.group(2),
-            "bytes": _type_bytes(m.group(1)),
-            "type": m.group(1),
-        })
+        out.append({"op": base, "bytes": ins["bytes"], "type": ins["type"]})
     return out
 
 
@@ -85,6 +145,56 @@ def dominant_collectives(hlo_text: str) -> Dict[str, int]:
     for c in parse_collectives(hlo_text):
         best[c["op"]] = max(best.get(c["op"], 0), c["bytes"])
     return best
+
+
+def largest_result(hlo_text: str) -> Optional[Dict]:
+    """The instruction with the largest result buffer — the peak single
+    live value the program materializes (``{"op", "bytes", "type"}``), or
+    None for instruction-free text."""
+    instrs = parse_instructions(hlo_text)
+    if not instrs:
+        return None
+    return max(instrs, key=lambda i: i["bytes"])
+
+
+def dtype_census(hlo_text: str) -> Dict[str, int]:
+    """How many instruction results carry each element dtype — the
+    program's dtype vocabulary. An ``f64`` entry in a program built under
+    default-f32 jax is a precision leak (a stray Python float promoted to
+    double somewhere upstream of the jit)."""
+    census: Dict[str, int] = {}
+    for m in _INSTR_RE.finditer(hlo_text):
+        for d in _result_dtypes(m.group(1)):
+            census[d] = census.get(d, 0) + 1
+    return census
+
+
+def parse_input_output_aliases(compiled_text: str) -> List[Dict]:
+    """The ``input_output_alias`` entries of the compiled module header:
+    ``[{"output": (0,), "param": 0, "kind": "may-alias"}]``. An empty list
+    means NO donated input survived to the executable — every donation was
+    dropped at compile time."""
+    header = compiled_text.split("\n", 1)[0]
+    out = []
+    for m in _ALIAS_ENTRY_RE.finditer(header):
+        idx = tuple(int(x) for x in m.group(1).replace(" ", "").split(",")
+                    if x != "")
+        out.append({
+            "output": idx,
+            "param": int(m.group(2)),
+            "kind": m.group(3) or "may-alias",
+        })
+    return out
+
+
+def count_donated_inputs(lowered_text: str) -> int:
+    """Donated arguments in AOT-lowered StableHLO: jax marks each donated
+    leaf with ``tf.aliasing_output`` (committed alias) or
+    ``jax.buffer_donor`` (donate-to-any). The pre-compile side of the
+    donation audit — compare with :func:`parse_input_output_aliases` on the
+    compiled text to prove donations survive XLA."""
+    return (lowered_text.count("tf.aliasing_output")
+            + lowered_text.count("jax.buffer_donor"))
 
 
 def record_collective_bytes(hlo_text: str, program: str,

@@ -161,6 +161,8 @@ class TaskSupervisor:
         ``{"renewed": [...], "resumed": [...], "failed": [...],
         "finalized": [...]}`` for tests and operators. ``now`` overrides
         wall-clock for deterministic tests."""
+        # lint: allow-wall-clock — expiry scans compare lease_expires
+        # wall-clock timestamps persisted by the owning worker process.
         now = time.time() if now is None else now
         digest: Dict[str, Any] = {"renewed": [], "resumed": [], "failed": [],
                                   "finalized": [], "fenced": []}
@@ -254,8 +256,8 @@ class TaskSupervisor:
                 if not self.deviceflow.check_dispatch_finished(task_id):
                     return  # retry on a later scan
                 self.deviceflow.unregister_task(task_id)
-            except Exception:  # noqa: BLE001 — a deviceflow hiccup must not
-                pass          # block finalization forever
+            except Exception:  # lint: allow-silent — a deviceflow hiccup
+                pass           # must not block finalization; scan retries
         self.task_repo.set_item_value(task_id, "resource_occupied", "0")
         self.task_repo.set_item_value(task_id, "task_status", final.name)
         self.task_repo.set_item_value(

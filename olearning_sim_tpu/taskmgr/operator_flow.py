@@ -21,6 +21,7 @@ registry so deployments can plug their own barriers.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -154,8 +155,12 @@ class WebsocketRoundProvider:
         if ws is not None:
             try:
                 ws.close()
-            except Exception:
-                pass
+            except Exception as e:
+                # Best-effort teardown of a possibly-dead socket; keep the
+                # failure observable for degraded-path debugging.
+                logging.getLogger(__name__).debug(
+                    "selection-service websocket close for %s failed: "
+                    "%s: %s", self.url, type(e).__name__, e)
 
     def __call__(self) -> Optional[int]:
         import json
@@ -168,7 +173,13 @@ class WebsocketRoundProvider:
             self._ws.send(json.dumps(self.query))
             resp = json.loads(self._ws.recv())
             return int(resp[self.round_key])
-        except Exception:
+        except Exception as e:
+            # Documented contract: None keeps the barrier polling — but a
+            # persistently-failing provider should be diagnosable, so the
+            # error is logged, not swallowed invisibly.
+            logging.getLogger(__name__).debug(
+                "selection-service poll of %s failed: %s: %s",
+                self.url, type(e).__name__, e)
             self._drop()
             return None
 

@@ -784,6 +784,8 @@ class TaskManager:
         fence ourselves by stopping the job, so exactly one process ever
         drives a task (the reclaimer's resumed job is now the task of
         record)."""
+        # lint: allow-wall-clock — renewals compare/extend the repo's
+        # persisted cross-process lease timestamps (see task_repo).
         now = now if now is not None else time.time()
         # Scope: jobs THIS manager launched (not the row's job_id column —
         # a supervisor reclaim overwrites that, and fencing must still see
@@ -848,6 +850,8 @@ class TaskManager:
     def interrupt_once(self, now: Optional[float] = None) -> None:
         """Watchdog (reference ``interruptTask``, ``task_manager.py:1150-1200``):
         kill tasks queued or running beyond their timeouts."""
+        # lint: allow-wall-clock — compared against in_queue_time /
+        # submit_task_time, wall-clock strings persisted by other processes.
         now = now if now is not None else time.time()
         for row in self._task_repo.query_all():
             task_id = row["task_id"]

@@ -469,14 +469,14 @@ class MySqlTableRepo(TableRepo):
                 except Exception:  # noqa: BLE001 — DBAPI error bases vary by driver
                     try:
                         self._conn.rollback()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:  # lint: allow-silent — cleanup of a
+                        pass           # failed conn; original error re-raised
                     if attempt:
                         raise
                     try:
                         self._conn.close()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:  # lint: allow-silent — closing the
+                        pass           # dead conn before the reconnect retry
                     self._conn = self._connect()
 
     def add_item(self, item: Dict[str, List[Any]]) -> bool:

@@ -49,18 +49,20 @@ def _py_files(root):
                 yield os.path.join(dirpath, f)
 
 
-def declared_kinds():
-    """constant name -> kind value, from resilience/events.py."""
-    with open(EVENTS, encoding="utf-8") as f:
+def declared_kinds(events=None):
+    """constant name -> kind value, from resilience/events.py (or an
+    injected declarations file — seeded-violation tests)."""
+    with open(events or EVENTS, encoding="utf-8") as f:
         src = f.read()
     return {m.group(1): m.group(2) for m in DECL_RE.finditer(src)}
 
 
-def emitted_kinds():
+def emitted_kinds(pkg=None):
     """(constant-or-None, literal-or-None) -> [repo-relative call sites]."""
     emissions = {}
-    for path in _py_files(PKG):
-        rel = os.path.relpath(path, REPO)
+    root = pkg or PKG
+    for path in _py_files(root):
+        rel = os.path.relpath(path, os.path.dirname(root))
         with open(path, encoding="utf-8") as f:
             src = f.read()
         for m in RECORD_RE.finditer(src):
@@ -68,20 +70,22 @@ def emitted_kinds():
     return emissions
 
 
-def check() -> list:
-    """Returns the list of violations (empty = clean)."""
+def check(events=None, doc_path=None, pkg=None) -> list:
+    """Returns the list of violations (empty = clean). The path
+    parameters inject seeded trees (tests); defaults are the real repo."""
     problems = []
-    decls = declared_kinds()
+    decls = declared_kinds(events)
     if not decls:
         return ["no event kinds declared — the events.py regex rotted"]
+    doc_path = doc_path or DOC
     try:
-        with open(DOC, encoding="utf-8") as f:
+        with open(doc_path, encoding="utf-8") as f:
             doc = f.read()
     except OSError as e:
-        return [f"cannot read {DOC}: {e}"]
+        return [f"cannot read {doc_path}: {e}"]
 
     emitted_values = set()
-    for (const, literal), sites in sorted(emitted_kinds().items()):
+    for (const, literal), sites in sorted(emitted_kinds(pkg).items()):
         if const is not None:
             if const not in decls:
                 problems.append(

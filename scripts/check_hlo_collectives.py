@@ -90,14 +90,20 @@ def build_defended_lowering(dp: int = 2, num_clients: int = NUM_CLIENTS,
 
 
 def analyze(dp: int = 2, shard_server_update: bool = False,
-            record: bool = True) -> tuple:
+            record: bool = True, prebuilt=None) -> tuple:
     """(violations, dominant-collective bytes per kind) — one build+compile
-    serves both the guard and the summary/gauge."""
+    serves both the guard and the summary/gauge. ``prebuilt`` injects an
+    already-compiled ``(hlo_text, params_bytes, clients)`` triple — the
+    check_all driver shares the analysis-grid compile, and seeded-violation
+    tests feed a known-bad program."""
     from olearning_sim_tpu.engine import hlo_stats
 
-    text, params_bytes, clients = build_defended_lowering(
-        dp=dp, shard_server_update=shard_server_update
-    )
+    if prebuilt is not None:
+        text, params_bytes, clients = prebuilt
+    else:
+        text, params_bytes, clients = build_defended_lowering(
+            dp=dp, shard_server_update=shard_server_update
+        )
     threshold = clients * params_bytes // dp
     problems = []
     collectives = hlo_stats.parse_collectives(text)
@@ -125,10 +131,10 @@ def analyze(dp: int = 2, shard_server_update: bool = False,
 
 
 def check(dp: int = 2, shard_server_update: bool = False,
-          record: bool = True) -> list:
+          record: bool = True, prebuilt=None) -> list:
     """Returns the list of violations (empty = clean)."""
     return analyze(dp=dp, shard_server_update=shard_server_update,
-                   record=record)[0]
+                   record=record, prebuilt=prebuilt)[0]
 
 
 def main() -> int:

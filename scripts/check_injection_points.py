@@ -51,11 +51,12 @@ def _py_files(root):
                 yield os.path.join(dirpath, f)
 
 
-def collect_points():
+def collect_points(pkg=None):
     """point name -> [repo-relative call sites]."""
     points = {}
-    for path in _py_files(PKG):
-        rel = os.path.relpath(path, REPO)
+    root = pkg or PKG
+    for path in _py_files(root):
+        rel = os.path.relpath(path, os.path.dirname(root))
         with open(path, encoding="utf-8") as f:
             src = f.read()
         for regex in (DIRECT_RE, SEAM_RE):
@@ -72,18 +73,20 @@ def _doc_injection_section(doc_text: str) -> str:
     return m.group(1) if m else ""
 
 
-def check() -> list:
-    """Returns the list of violations (empty = clean)."""
+def check(pkg=None, doc_path=None, tests_dir=None) -> list:
+    """Returns the list of violations (empty = clean). The path
+    parameters inject seeded trees (tests); defaults are the real repo."""
     problems = []
-    points = collect_points()
+    points = collect_points(pkg)
     if not points:
         return ["no injection points found — the collector regexes rotted"]
 
+    doc_path = doc_path or DOC
     try:
-        with open(DOC, encoding="utf-8") as f:
+        with open(doc_path, encoding="utf-8") as f:
             doc = f.read()
     except OSError as e:
-        return [f"cannot read {DOC}: {e}"]
+        return [f"cannot read {doc_path}: {e}"]
     section = _doc_injection_section(doc)
     if not section:
         problems.append(
@@ -92,7 +95,7 @@ def check() -> list:
     doc_points = set(DOC_POINT_RE.findall(section))
 
     test_srcs = {}
-    for path in _py_files(TESTS):
+    for path in _py_files(tests_dir or TESTS):
         with open(path, encoding="utf-8") as f:
             test_srcs[os.path.relpath(path, REPO)] = f.read()
 

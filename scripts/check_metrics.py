@@ -56,12 +56,14 @@ def _py_files(root):
                 yield os.path.join(dirpath, f)
 
 
-def check(catalog=None) -> list:
-    """Returns the list of violations (empty = clean)."""
+def check(catalog=None, pkg=None) -> list:
+    """Returns the list of violations (empty = clean). ``pkg`` injects a
+    seeded source tree (tests); the default is the real package."""
     if catalog is None:
         from olearning_sim_tpu.telemetry import CATALOG as catalog
     from olearning_sim_tpu.telemetry import COUNTER, HISTOGRAM
 
+    pkg = pkg or PKG
     problems = []
     for name, spec in catalog.items():
         kind = spec[0]
@@ -88,8 +90,8 @@ def check(catalog=None) -> list:
             )
 
     referenced = {}
-    for path in _py_files(PKG):
-        rel = os.path.relpath(path, REPO)
+    for path in _py_files(pkg):
+        rel = os.path.relpath(path, os.path.dirname(pkg))
         with open(path, encoding="utf-8") as f:
             src = f.read()
         for m in INSTRUMENT_RE.finditer(src):

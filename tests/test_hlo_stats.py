@@ -1,0 +1,96 @@
+"""Focused parser tests for engine/hlo_stats: the HLO instruction walker
+must read real post-optimization text — scalar and token result types,
+tuple results with (tiled) layouts, async pairs, sub-byte dtypes — because
+the budget audit (analysis/hlo_audit) trusts these numbers."""
+
+from olearning_sim_tpu.engine import hlo_stats as hs
+
+# Shaped like real `compile().as_text()` output (CPU + TPU idioms).
+SNIPPET = """\
+HloModule jit_round_step, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, may-alias) }, entry_computation_layout={(f32[16]{0})->(f32[16]{0})}, num_partitions=2
+
+%region_0.6 (Arg_0.7: f32[], Arg_1.8: f32[]) -> f32[] {
+  %Arg_0.7 = f32[] parameter(0)
+  %Arg_1.8 = f32[] parameter(1)
+  ROOT %add.9 = f32[] add(f32[] %Arg_0.7, f32[] %Arg_1.8)
+}
+
+ENTRY %main.42 (p0.1: f32[16]) -> (f32[16]) {
+  %p0.1 = f32[16]{0} parameter(0)
+  %tok = token[] after-all()
+  %outfeed = token[] outfeed(f32[16]{0} %p0.1, token[] %tok)
+  %ag-start.1 = (f32[8,64]{1,0}, f32[16,64]{1,0}) all-gather-start(f32[8,64]{1,0} %p0.1), dimensions={0}
+  %ag-done.1 = f32[16,64]{1,0} all-gather-done((f32[8,64]{1,0}, f32[16,64]{1,0}) %ag-start.1)
+  %a2a.2 = (f32[4,3]{1,0:T(8,128)}, f32[4,3]{1,0:T(8,128)}) all-to-all(f32[4,3]{1,0} %x, f32[4,3]{1,0} %y)
+  %rs.3 = bf16[8,64]{1,0} reduce-scatter(bf16[16,64]{1,0} %h), dimensions={0}
+  %ar.4 = f32[] all-reduce(f32[] %s), to_apply=%region_0.6
+  %quant.5 = u4[1000]{0} convert(s32[1000]{0} %q)
+  %halfnib = s4[7]{0} convert(s32[7]{0} %q2)
+  ROOT %big.6 = f32[128,512]{1,0} fusion(f32[] %c), kind=kLoop
+}
+"""
+
+
+def test_scalar_and_token_result_types():
+    assert hs._type_bytes("f32[]") == 4
+    assert hs._type_bytes("pred[]") == 1
+    assert hs._type_bytes("token[]") == 0
+    assert hs._type_bytes("(token[], f32[])") == 4
+    # Scalars parse as instructions too (all-reduce over f32[]).
+    assert hs.dominant_collectives(SNIPPET)["all-reduce"] == 4
+
+
+def test_tuple_results_with_tiled_layouts():
+    # TPU layouts carry tile annotations with parens inside the layout
+    # braces; the tuple must still parse and size each element.
+    assert hs._type_bytes("(f32[4,3]{1,0:T(8,128)}, f32[4,3]{1,0})") == 96
+    assert hs.dominant_collectives(SNIPPET)["all-to-all"] == 2 * 4 * 3 * 4
+
+
+def test_sub_byte_dtypes_count_packed_storage():
+    assert hs._type_bytes("u4[1000]") == 500
+    assert hs._type_bytes("s4[7]") == 4  # ceil(7 nibbles / 2)
+    assert hs._type_bytes("u4[]") == 1   # scalar still occupies a byte
+    census = hs.dtype_census(SNIPPET)
+    assert census["u4"] == 1 and census["s4"] == 1
+
+
+def test_async_pairs_counted_at_done_only():
+    ags = [c for c in hs.parse_collectives(SNIPPET)
+           if c["op"] == "all-gather"]
+    # The -start context tuple (8x64 + 16x64 floats) must not be counted;
+    # only the -done's 16x64 output buffer.
+    assert [c["bytes"] for c in ags] == [16 * 64 * 4]
+
+
+def test_instruction_walk_and_largest_result():
+    ops = {i["op"] for i in hs.parse_instructions(SNIPPET)}
+    assert {"parameter", "after-all", "outfeed", "fusion",
+            "convert", "reduce-scatter"} <= ops
+    big = hs.largest_result(SNIPPET)
+    assert big["op"] == "fusion" and big["bytes"] == 128 * 512 * 4
+
+
+def test_dtype_census_flags_f64():
+    assert "f64" not in hs.dtype_census(SNIPPET)
+    leaked = SNIPPET + "\n  %d = f64[8]{0} convert(f32[8]{0} %p0.1)\n"
+    assert hs.dtype_census(leaked)["f64"] == 1
+
+
+def test_alias_header_parsing():
+    aliases = hs.parse_input_output_aliases(SNIPPET)
+    assert aliases == [
+        {"output": (0,), "param": 0, "kind": "may-alias"},
+        {"output": (1,), "param": 1, "kind": "may-alias"},
+    ]
+    assert hs.parse_input_output_aliases("HloModule jit_f\nbody") == []
+
+
+def test_donor_counting_in_lowered_stablehlo():
+    lowered = (
+        "func.func public @main(%arg0: tensor<4xf32> "
+        "{tf.aliasing_output = 0 : i32}, %arg1: tensor<4xf32> "
+        "{jax.buffer_donor = true}, %arg2: tensor<4xf32>)"
+    )
+    assert hs.count_donated_inputs(lowered) == 2
+    assert hs.count_donated_inputs("func.func public @main()") == 0
