@@ -23,9 +23,12 @@ Scale-out modes (docs/performance.md): ``--chips N`` runs every family on
 a mesh over the first N devices (per-chip normalization reads the mesh
 size, not the host's device count); ``--multichip`` banks the
 chips={1,2,4,8} plain+defended scaling family into
-``BENCH_multichip.json``. All bench processes share the persistent XLA
-compile cache (``artifacts/xla_compile_cache``; ``OLS_COMPILE_CACHE=0``
-disables) and record its hit/miss counters per family.
+``BENCH_multichip.json``; ``--async`` banks the buffered-async vs
+sync-deadline pair (committed device-rounds/sec at straggler-heavy
+pacing) plus the 2-task multiplex record into ``BENCH_async.json``. All
+bench processes share the persistent XLA compile cache
+(``artifacts/xla_compile_cache``; ``OLS_COMPILE_CACHE=0`` disables) and
+record its hit/miss counters per family.
 """
 
 import json
@@ -59,7 +62,9 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
                local_steps=10, block=256, timed_rounds=3, unroll=1,
                block_unroll=1, carry=None, model_overrides=None,
                vocab_size=None, seq_len=None, deadline_frac=None,
-               attack_frac=None, defense=None, shard_server=False):
+               attack_frac=None, defense=None, shard_server=False,
+               straggler_spike=None, async_buffer=None,
+               async_schedule="polynomial"):
     """One benchmark family: build, warm, time. Returns the record dict.
 
     ``carry``: "bf16" runs local SGD with a bfloat16 params carry (halves
@@ -82,11 +87,35 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
     (FedCoreConfig.shard_server_update — O(params/dp) optimizer state;
     the chips-scaling family's configuration).
 
+    ``straggler_spike``: ``(frac, factor)`` — seeded straggler-heavy
+    completion times (p95 >> median): that fraction of the real clients
+    takes ``factor`` x the fast cohort's simulated time. Without
+    ``async_buffer`` this runs the synchronous deadline-masked baseline
+    (round closes at the fast cohort's tail; stragglers DROPPED in-jit).
+    With ``async_buffer`` (= M) the buffered asynchronous program commits
+    every M arrivals with ``async_schedule`` staleness weights instead —
+    the same compute commits the stragglers rather than discarding them.
+    The sync-vs-async pair on identical completion times is the
+    BENCH_async.json headline (committed device-rounds/sec).
+
     The record's ``chips`` is the MESH size actually used (``--chips``
     subdivides the host), not the host's device count.
     """
     import jax.numpy as jnp
 
+    if deadline_frac is not None and straggler_spike is not None:
+        raise ValueError(
+            "deadline_frac and straggler_spike are mutually exclusive "
+            "pacing knobs: straggler_spike builds its own completion/"
+            "deadline (sync) or async plan and would silently replace "
+            "the deadline_frac pacing while the record still claimed it"
+        )
+    if async_buffer is not None and straggler_spike is None:
+        raise ValueError(
+            "async_buffer requires straggler_spike pacing (the async "
+            "plan is built from its simulated arrivals); without it the "
+            "family would silently run synchronously"
+        )
     carry_dtype = jnp.bfloat16 if carry == "bf16" else None
     cfg = FedCoreConfig(batch_size=batch, max_local_steps=local_steps,
                         block_clients=block, step_unroll=unroll,
@@ -124,6 +153,39 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
             completion_time=global_put(comp, plan.client_sharding()),
             deadline=float(np.quantile(comp, 1.0 - float(deadline_frac))),
         )
+    astats = None
+    if straggler_spike is not None:
+        # Straggler-heavy pacing: the fast cohort finishes inside 1.0
+        # simulated second; ``frac`` of the real population takes
+        # ``factor`` x that (p95 >> median). Seeded — the sync and async
+        # entries of the pair see the IDENTICAL arrival process.
+        from olearning_sim_tpu.parallel.mesh import global_put
+
+        frac, factor = float(straggler_spike[0]), float(straggler_spike[1])
+        real = ds.num_real_clients
+        rng = np.random.default_rng(2)
+        comp = (0.2 + 0.8 * rng.random(ds.num_clients)).astype(np.float32)
+        slow = rng.choice(real, size=max(1, int(frac * real)), replace=False)
+        comp[slow] *= factor
+        if async_buffer is None:
+            # Synchronous deadline-masked baseline: the round closes at
+            # the fast cohort's tail, so every spiked client's update is
+            # computed and then discarded in-jit (PR 3 semantics).
+            pace_kwargs = dict(
+                completion_time=global_put(comp, plan.client_sharding()),
+                deadline=1.0,
+            )
+        else:
+            from olearning_sim_tpu.engine.async_rounds import (
+                AsyncConfig,
+                plan_async_round,
+            )
+
+            acfg = AsyncConfig(buffer_size=int(async_buffer),
+                               schedule=async_schedule)
+            pace_kwargs["async_plan"] = plan_async_round(
+                acfg, comp[:real], np.ones(real, bool), ds.num_clients
+            )
     if attack_frac is not None:
         # Seeded sign-flip attack on ~attack_frac of the REAL population
         # (padding clients have zero weight — drawing them would dilute
@@ -146,11 +208,14 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
         pace_kwargs["defense"] = defense
 
     def step():
-        nonlocal state, personal
+        nonlocal state, personal, astats
         if personal is not None:
             out = core.round_step(state, ds, personal=personal,
                                   **pace_kwargs)
             state, metrics, personal = out
+        elif "async_plan" in pace_kwargs:
+            state, metrics, astats = core.round_step(state, ds,
+                                                     **pace_kwargs)
         else:
             state, metrics = core.round_step(state, ds, **pace_kwargs)
         return metrics
@@ -191,6 +256,24 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
         **({"deadline_frac": float(deadline_frac),
             "stragglers": int(metrics.stragglers)}
            if deadline_frac is not None else {}),
+        # Committed device-rounds/sec is the async headline's currency:
+        # clients_trained counts only clients whose update actually
+        # entered the server model (deadline masking zeroes straggler
+        # weights BEFORE the count; the async program counts committed
+        # buffer members), so one formula is honest for both modes.
+        **({"straggler_spike": {"frac": float(straggler_spike[0]),
+                                "factor": float(straggler_spike[1])},
+            "committed_clients": int(metrics.clients_trained),
+            "committed_device_rounds_per_sec": round(
+                float(rps * int(metrics.clients_trained)), 1),
+            "mode": "sync_deadline" if async_buffer is None else "async"}
+           if straggler_spike is not None else {}),
+        **({"async": {"buffer_size": int(async_buffer),
+                      "schedule": async_schedule,
+                      "windows": int(astats.buffer_fill.shape[0]),
+                      "commits": int(astats.commits),
+                      "stale_dropped": int(astats.dropped_stale)}}
+           if astats is not None else {}),
         **({"attack_frac": float(attack_frac)}
            if attack_frac is not None else {}),
         **({"defense": defense.aggregator,
@@ -517,6 +600,23 @@ def _with_provenance(record, nominal, backend, degraded):
     return out
 
 
+def _bank(obj, path_or_name):
+    """Atomically bank a benchmark artifact (tmp write -> os.replace).
+
+    Relative names resolve next to bench.py — the checked-in location
+    the acceptance records and docs read. Returns the final path."""
+    path = path_or_name
+    if not os.path.isabs(path):
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), path
+        )
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
 def _merge_suite(record, path=None):
     """Merge one family record into BENCH_suite.json keyed by family name.
 
@@ -550,10 +650,7 @@ def _merge_suite(record, path=None):
         suite.append(record)
     elif rank(record) >= rank(suite[i]):
         suite[i] = record
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(suite, f, indent=1)
-    os.replace(tmp, path)
+    _bank(suite, path)
 
 
 def _isolate():
@@ -867,9 +964,6 @@ def run_multichip(out_name="BENCH_multichip.json"):
             record.setdefault("captured_unix", round(time.time(), 1))
             print(json.dumps(record), flush=True)
             entries.append(record)
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), out_name
-    )
     payload = {
         "captured_unix": round(time.time(), 1),
         "backend": backend,
@@ -882,10 +976,177 @@ def run_multichip(out_name="BENCH_multichip.json"):
                  "measurements (methodology: docs/performance.md)."),
         "entries": entries,
     }
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=1)
-    os.replace(tmp, path)
+    _bank(payload, out_name)
+    return payload
+
+
+# ------------------------------------------------------------- async
+# ISSUE 8 / ROADMAP item 2: the buffered asynchronous engine's bench of
+# record (BENCH_async.json). Two claims, one file:
+#
+#  1. fedavg_mnist_mlp_1k_async — at straggler-heavy pacing (half the
+#     fleet 8x slower: p95 >> median) the buffered asynchronous program
+#     commits >= 1.5x the device-rounds/sec of the synchronous
+#     deadline-masked baseline on the SAME config and the IDENTICAL
+#     seeded completion times: the sync program computes the stragglers'
+#     updates and discards them at the deadline, the async program
+#     commits them with staleness-discounted weights (engine/
+#     async_rounds.py; semantics in docs/performance.md).
+#  2. A 2-task multiplex record — two device-paced tasks driven by one
+#     MultiTaskDispatcher (threaded interleave) vs the same two tasks run
+#     serially. Each task's rounds wait out the simulated fleet's
+#     wall-clock round trip (the operator-flow polling idle a device-
+#     cloud engine actually sees); the dispatcher fills that idle with
+#     the other task's compute, so aggregate committed device-rounds/sec
+#     rises >= 1.3x without changing either task's math (bitwise-solo
+#     guarantee tested in tests/test_async.py).
+ASYNC_FAMILY = dict(
+    name="fedavg_mnist_mlp_1k_async", model="mlp2",
+    algorithm=("fedavg", dict(local_lr=0.05)), num_clients=1024, n_local=8,
+    input_shape=(28, 28, 1), block=32, batch=8, local_steps=2,
+    timed_rounds=3,
+)
+ASYNC_SPIKE = (0.5, 8.0)  # half the fleet 8x slower: p95 >> median
+ASYNC_BUFFER = 128  # M: commit every 128 arrivals (8 windows over 1k)
+ASYNC_TIMEOUT_S = int(os.environ.get("OLS_BENCH_ASYNC_TIMEOUT", "600"))
+MUX_ROUND_TRIP_S = float(os.environ.get("OLS_BENCH_MUX_ROUND_TRIP", "0.25"))
+MUX_ROUNDS = 6
+
+
+def _mux_runner(core, ds, task_id, rounds, round_trip_s, acfg):
+    from olearning_sim_tpu.engine.runner import (
+        DataPopulation,
+        OperatorSpec,
+        SimulationRunner,
+    )
+
+    def device_pace(runner, round_idx, operator, population):
+        # The simulated fleet's wall-clock round trip (dispatch -> last
+        # needed arrival): the operator-flow polling barrier a device-
+        # cloud round actually blocks on. A one-task process idles here.
+        time.sleep(round_trip_s)
+        return {}
+
+    pop = DataPopulation(
+        name="data_0", dataset=ds, device_classes=["c"],
+        class_of_client=np.zeros(ds.num_clients, int),
+        nums=[ds.num_real_clients], dynamic_nums=[0],
+    )
+    return SimulationRunner(
+        task_id=task_id, core=core, populations=[pop],
+        operators=[OperatorSpec(name="train"),
+                   OperatorSpec(name="device_pace", kind="custom",
+                                custom_fn=device_pace)],
+        rounds=rounds, async_config=acfg,
+    )
+
+
+def run_async_multiplex(round_trip_s=None, rounds=MUX_ROUNDS):
+    """Aggregate throughput of 2 device-paced tasks under one threaded
+    MultiTaskDispatcher vs the same tasks run serially (in-process)."""
+    from olearning_sim_tpu.engine.async_rounds import AsyncConfig
+    from olearning_sim_tpu.engine.runner import MultiTaskDispatcher
+
+    round_trip_s = MUX_ROUND_TRIP_S if round_trip_s is None else round_trip_s
+    plan = make_mesh_plan()
+    cfg = FedCoreConfig(batch_size=8, max_local_steps=2, block_clients=16)
+    core = build_fedcore(
+        "mlp2", make_algorithm(("fedavg", {"local_lr": 0.05})), plan, cfg,
+        input_shape=(28, 28, 1),
+    )
+    ds = make_synthetic_dataset(
+        seed=0, num_clients=64, n_local=8, input_shape=(28, 28, 1),
+        num_classes=10, dirichlet_alpha=0.5,
+    ).pad_for(plan, 16).place(plan)
+    acfg = AsyncConfig(buffer_size=16, schedule="polynomial",
+                       default_step_s=0.05, jitter=0.1)
+
+    # Warm the async program variant once: both measurements share the
+    # core's variant cache, so neither pays compile.
+    _mux_runner(core, ds, "mux-warm", 1, 0.0, acfg).run()
+
+    def committed(history):
+        return sum(h["train"]["data_0"]["committed"] for h in history)
+
+    t0 = time.perf_counter()
+    serial_committed = 0
+    for tid in ("mux-serial-a", "mux-serial-b"):
+        serial_committed += committed(
+            _mux_runner(core, ds, tid, rounds, round_trip_s, acfg).run()
+        )
+    serial_s = time.perf_counter() - t0
+
+    runners = [_mux_runner(core, ds, tid, rounds, round_trip_s, acfg)
+               for tid in ("mux-a", "mux-b")]
+    t0 = time.perf_counter()
+    results = MultiTaskDispatcher(runners, interleave="thread").run()
+    mux_s = time.perf_counter() - t0
+    mux_committed = sum(committed(h) for h in results.values())
+
+    serial_rate = serial_committed / serial_s
+    mux_rate = mux_committed / mux_s
+    return {
+        "tasks": 2,
+        "rounds_per_task": rounds,
+        "device_paced": True,
+        "round_trip_s": round_trip_s,
+        "serial_seconds": round(serial_s, 3),
+        "multiplex_seconds": round(mux_s, 3),
+        "serial_device_rounds_per_sec": round(serial_rate, 1),
+        "multiplex_device_rounds_per_sec": round(mux_rate, 1),
+        "aggregate_speedup": round(mux_rate / serial_rate, 3),
+    }
+
+
+def run_async_bench(out_name="BENCH_async.json"):
+    """Capture the async family pair + the 2-task multiplex record; one
+    JSON line per entry, banked atomically like the multichip sweep."""
+    backend, degraded = select_backend()
+    # Throughput claims off real accelerator hardware are degraded
+    # measurements, same policy as the multichip curves.
+    degraded = degraded or backend != "tpu"
+    entries = []
+    for mode, extra in (("sync", {}),
+                        ("async", {"async_buffer": ASYNC_BUFFER})):
+        fam = {**ASYNC_FAMILY, **extra,
+               "straggler_spike": list(ASYNC_SPIKE),
+               "name": f"{ASYNC_FAMILY['name']}_{mode}"}
+        record = run_family_subprocess(fam, timeout_s=ASYNC_TIMEOUT_S)
+        record.update(backend=record.get("backend", backend),
+                      degraded=degraded)
+        record.setdefault("captured_unix", round(time.time(), 1))
+        print(json.dumps(record), flush=True)
+        entries.append(record)
+    speedup = None
+    try:
+        speedup = round(
+            entries[1]["committed_device_rounds_per_sec"]
+            / entries[0]["committed_device_rounds_per_sec"], 3
+        )
+    except (KeyError, IndexError, ZeroDivisionError, TypeError):
+        pass
+    try:
+        mux = run_async_multiplex()
+        mux["degraded"] = degraded
+    except Exception as e:  # noqa: BLE001 — bank what we measured
+        mux = {"error": str(e)[-500:]}
+    print(json.dumps({"multiplex": mux}), flush=True)
+    payload = {
+        "captured_unix": round(time.time(), 1),
+        "backend": backend,
+        "degraded": degraded,
+        "family": ASYNC_FAMILY["name"],
+        "note": ("sync deadline-masked baseline vs buffered async on "
+                 "identical straggler-heavy completion times (headline: "
+                 "committed device-rounds/sec), plus 2 device-paced "
+                 "tasks multiplexed on one process vs serial. CPU "
+                 "entries are degraded measurements (methodology: "
+                 "docs/performance.md)."),
+        "entries": entries,
+        "async_vs_sync_committed_device_rounds": speedup,
+        "multiplex": mux,
+    }
+    _bank(payload, out_name)
     return payload
 
 
@@ -900,6 +1161,8 @@ if __name__ == "__main__":
         run_one(sys.argv[i + 1], sys.argv[sys.argv.index("--out") + 1])
     elif "--multichip" in sys.argv:
         run_multichip()
+    elif "--async" in sys.argv:
+        run_async_bench()
     elif "--family" in sys.argv:
         run_family_once(sys.argv[sys.argv.index("--family") + 1])
     else:

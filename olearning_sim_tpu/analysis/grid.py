@@ -29,7 +29,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Iterable, List, Optional, Tuple
 
-PROGRAMS = ("plain", "deadline", "attack", "defense", "maximal")
+PROGRAMS = ("plain", "deadline", "attack", "defense", "maximal",
+            "async", "async_defense")
+
+# Buffer size for the async grid variants: 16 clients / M=4 -> a 4-window
+# commit scan, so the compiled buffer structure (segment_sum + commit
+# scan) is exercised with real multi-window data.
+ASYNC_BUFFER = 4
 
 NUM_CLIENTS = 16
 INPUT_SHAPE = (8,)
@@ -114,6 +120,41 @@ def _knob_kwargs(program: str, core, ds, setting: str) -> Dict:
 
     b = setting == "b"
     kwargs: Dict = {}
+    if program in ("async", "async_defense"):
+        # Buffered async rounds: the two settings differ in EVERY
+        # per-round data input — arrival order (window assignments),
+        # staleness_alpha, and max_staleness (disabled vs binding) — while
+        # M (the structural knob) stays fixed, so both must resolve to
+        # one compiled program.
+        from olearning_sim_tpu.engine.async_rounds import (
+            AsyncConfig,
+            plan_async_round,
+        )
+
+        acfg = AsyncConfig(
+            buffer_size=ASYNC_BUFFER,
+            staleness_alpha=0.5 if not b else 1.5,
+            max_staleness=None if not b else 2,
+            schedule="polynomial",
+        )
+        completion = np.linspace(
+            0.2, 3.0 if not b else 9.0, ds.num_clients
+        ).astype(np.float32)
+        if b:
+            completion = completion[::-1].copy()  # reversed arrival order
+        kwargs["async_plan"] = plan_async_round(
+            acfg, completion, np.ones(ds.num_clients, bool), ds.num_clients
+        )
+    if program == "async_defense":
+        kwargs["defense"] = DefenseConfig(
+            clip_norm=5.0 if not b else None,  # None = disabled sentinel
+            aggregator="trimmed_mean",
+            trim_fraction=0.1 if not b else 0.4,
+            anomaly_threshold=4.0,
+        )
+        return kwargs
+    if program == "async":
+        return kwargs
     if program in ("deadline", "maximal"):
         completion = np.linspace(
             0.2, 3.0 if not b else 9.0, ds.num_clients
@@ -157,11 +198,21 @@ def artifacts(variant: Variant) -> Dict:
     # read how many times this variant's body was traced — 1 iff the
     # second knob setting hit the cached trace (the executable-cache-key
     # guarantee; a retrace would bump it to 2).
-    key = (
-        "deadline" in kwargs_a, "attack_scale" in kwargs_a,
-        kwargs_a["defense"].structure_key
-        if "defense" in kwargs_a else None,
-    )
+    if "async_plan" in kwargs_a:
+        from olearning_sim_tpu.engine.async_rounds import async_variant_key
+
+        ap = kwargs_a["async_plan"]
+        key = async_variant_key(
+            ap.num_windows, ap.config.schedule,
+            "attack_scale" in kwargs_a,
+            kwargs_a.get("defense"),
+        )
+    else:
+        key = (
+            "deadline" in kwargs_a, "attack_scale" in kwargs_a,
+            kwargs_a["defense"].structure_key
+            if "defense" in kwargs_a else None,
+        )
     trace_count = core.trace_counts.get(key, 0)
 
     compiled = lowered.compile()

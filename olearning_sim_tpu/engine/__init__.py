@@ -15,6 +15,7 @@ from olearning_sim_tpu.engine.algorithms import (
     from_config,
     scaffold,
 )
+from olearning_sim_tpu.engine.async_rounds import AsyncConfig
 from olearning_sim_tpu.engine.defense import DefenseConfig
 from olearning_sim_tpu.engine.fedcore import (
     ControlState,
@@ -32,6 +33,7 @@ from olearning_sim_tpu.engine.pacing import (
 
 __all__ = [
     "Algorithm",
+    "AsyncConfig",
     "ClientDataset",
     "ControlState",
     "DeadlineConfig",

@@ -307,6 +307,62 @@ def _tree_l2_sq(a, b):
     return jax.tree.reduce(jnp.add, leaves, jnp.float32(0.0))
 
 
+def _attack_deltas(deltas, batk):
+    """Byzantine update attack: the client "trains honestly" but ships a
+    transformed delta (sign_flip = -1, scale = factor). A benign scale of
+    exactly 1.0 is a bitwise no-op, so an all-ones attack vector
+    reproduces the attack-free program's outputs. Shared by the
+    synchronous and buffered-async program builders — a change here
+    changes BOTH compiled variants."""
+    return jax.tree.map(
+        lambda d: d * batk.astype(d.dtype).reshape(
+            (-1,) + (1,) * (d.ndim - 1)
+        ),
+        deltas,
+    )
+
+
+def _finite_client_mask(losses, deltas):
+    """[block] bool — clients whose local training stayed finite (finite
+    loss AND every delta leaf finite). The resilience gate both program
+    builders apply: a diverged client contributes NOTHING to the
+    aggregate — without it, one NaN client poisons the global params even
+    at weight 0 (the weighted reduction turns 0 * NaN into NaN). For
+    all-finite clients the downstream selects keep untouched values, so
+    healthy rounds are bitwise unchanged."""
+    ok = jnp.isfinite(losses)
+    for d in jax.tree.leaves(deltas):
+        ok = jnp.logical_and(
+            ok, jnp.isfinite(d.reshape(d.shape[0], -1)).all(axis=1)
+        )
+    return ok
+
+
+def _clip_client_deltas(d32, clip_norm):
+    """Per-client L2 norm clip over a block of f32 deltas: a delta beyond
+    the clip sphere is rescaled onto it. where-select (not a
+    multiply-by-1) so an unclipped delta — and the whole program under
+    the disabled-clip sentinel — stays bitwise untouched. Returns
+    ``(clipped_d32, too_big)``; shared by the synchronous and
+    buffered-async program builders."""
+    norm2 = functools.reduce(
+        jnp.add,
+        [jnp.square(l.reshape(l.shape[0], -1)).sum(axis=1)
+         for l in jax.tree.leaves(d32)],
+    )
+    too_big = norm2 > clip_norm * clip_norm
+    scale = jnp.where(too_big, clip_norm / jnp.sqrt(norm2), 1.0)
+    clipped = jax.tree.map(
+        lambda d: jnp.where(
+            too_big.reshape((-1,) + (1,) * (d.ndim - 1)),
+            d * scale.reshape((-1,) + (1,) * (d.ndim - 1)),
+            d,
+        ),
+        d32,
+    )
+    return clipped, too_big
+
+
 class FedCore:
     """Builds and owns the jitted round/eval programs for one (model,
     algorithm, mesh) triple."""
@@ -801,29 +857,10 @@ class FedCore:
                         in_axes=(None, 0, 0, 0, 0, 0, None, None),
                     )(params, bx, by, bns, bst, buid, base_key, round_idx)
                 if with_attack:
-                    # Byzantine update attack: the client "trains honestly"
-                    # but ships a transformed delta (sign_flip = -1,
-                    # scale = factor). A benign scale of exactly 1.0 is a
-                    # bitwise no-op, so an all-ones attack vector reproduces
-                    # the attack-free program's outputs.
-                    deltas = jax.tree.map(
-                        lambda d: d * batk.astype(d.dtype).reshape(
-                            (-1,) + (1,) * (d.ndim - 1)
-                        ),
-                        deltas,
-                    )
-                # Resilience gate: a client whose local training diverged
-                # (non-finite loss or any non-finite delta leaf) contributes
-                # NOTHING to the aggregate. Without this, one NaN client
-                # poisons the global params even at weight 0 — the weighted
-                # tensordot reduces 0 * NaN to NaN. For all-finite clients
-                # the gate selects the untouched values, so healthy rounds
-                # are bitwise unchanged.
-                ok = jnp.isfinite(losses)
-                for d in jax.tree.leaves(deltas):
-                    ok = jnp.logical_and(
-                        ok, jnp.isfinite(d.reshape(d.shape[0], -1)).all(axis=1)
-                    )
+                    deltas = _attack_deltas(deltas, batk)
+                # Resilience gate (_finite_client_mask): a diverged client
+                # contributes nothing, finite clients bitwise unchanged.
+                ok = _finite_client_mask(losses, deltas)
 
                 def gate(d):
                     return jnp.where(
@@ -833,31 +870,10 @@ class FedCore:
                 bw_eff = jnp.where(ok, bw, 0.0)
                 defense_ys = None
                 if defense is not None:
-                    # Per-client L2 norm clip: a delta beyond the clip
-                    # sphere is rescaled onto it. where-select (not a
-                    # multiply-by-1) so an unclipped delta — and the whole
-                    # program under clip_norm=inf — stays bitwise
-                    # untouched.
                     d32 = jax.tree.map(
                         lambda d: gate(d.astype(jnp.float32)), deltas
                     )
-                    norm2 = functools.reduce(
-                        jnp.add,
-                        [jnp.square(l.reshape(l.shape[0], -1)).sum(axis=1)
-                         for l in jax.tree.leaves(d32)],
-                    )
-                    too_big = norm2 > clip_norm * clip_norm
-                    scale = jnp.where(
-                        too_big, clip_norm / jnp.sqrt(norm2), 1.0
-                    )
-                    d32 = jax.tree.map(
-                        lambda d: jnp.where(
-                            too_big.reshape((-1,) + (1,) * (d.ndim - 1)),
-                            d * scale.reshape((-1,) + (1,) * (d.ndim - 1)),
-                            d,
-                        ),
-                        d32,
-                    )
+                    d32, too_big = _clip_client_deltas(d32, clip_norm)
                     n_clip = n_clip + jnp.logical_and(
                         bw_eff > 0, too_big
                     ).sum().astype(jnp.float32)
@@ -1316,6 +1332,7 @@ class FedCore:
         deadline: Optional[float] = None,
         attack_scale: Optional[jax.Array] = None,
         defense: Optional[Any] = None,
+        async_plan: Optional[Any] = None,
     ):
         """Resolve one FL round's compiled program variant and its launch
         arguments; ``round_step`` executes them, ``lower_round_step``
@@ -1349,6 +1366,18 @@ class FedCore:
         Krum-style per-client anomaly scores (``metrics.anomaly_score``).
         Scalar knobs (clip_norm, trim_fraction) are data; the aggregator
         choice and scoring toggle select a lazily-compiled program variant.
+
+        ``async_plan`` — optional
+        :class:`~olearning_sim_tpu.engine.async_rounds.AsyncRoundPlan`:
+        runs the buffered asynchronous round program instead of the
+        synchronous one (FedBuff-style staleness-weighted commits every
+        ``buffer_size`` arrivals; the call then returns
+        ``(state, metrics, async_stats)``). Window assignments, scores,
+        ``staleness_alpha`` and ``max_staleness`` are data; the buffer
+        capacity (from M) and schedule key the program variant. Mutually
+        exclusive with ``deadline`` (``max_staleness`` is the async
+        lateness control) and with personalized / control-variate
+        algorithms.
         """
         weight = ds.weight if participate is None else ds.weight * participate
         if num_steps is None:
@@ -1358,6 +1387,13 @@ class FedCore:
             )
         if defense is not None and not defense.enabled:
             defense = None
+        if async_plan is not None:
+            return self._prepare_async_args(
+                state, ds, async_plan, weight, num_steps,
+                completion_time=completion_time, deadline=deadline,
+                attack_scale=attack_scale, defense=defense,
+                personal=personal, control=control,
+            )
         if defense is not None and defense.gathers_deltas \
                 and self.algorithm.control_variates:
             raise ValueError(
@@ -1430,6 +1466,77 @@ class FedCore:
         return fn, (
             state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid,
             weight, *extras,
+        )
+
+    def _prepare_async_args(self, state, ds, async_plan, weight, num_steps,
+                            completion_time=None, deadline=None,
+                            attack_scale=None, defense=None,
+                            personal=None, control=None):
+        """Resolve the buffered-async program variant + launch arguments
+        for one :class:`~olearning_sim_tpu.engine.async_rounds.
+        AsyncRoundPlan` (see :meth:`_prepare_round_args`)."""
+        from olearning_sim_tpu.engine import async_rounds
+
+        if deadline is not None or completion_time is not None:
+            raise ValueError(
+                "async rounds and deadline masking are mutually exclusive "
+                "(async.max_staleness is the buffered engine's lateness "
+                "control; the completion-time model drives arrival order)"
+            )
+        if personal is not None or control is not None:
+            raise ValueError(
+                "async rounds do not take personal/control state "
+                "(personalized and control-variate algorithms are not "
+                "supported by the buffered engine)"
+            )
+        acfg = async_plan.config
+        W = int(async_plan.num_windows)
+        if W != acfg.num_windows(ds.num_clients):
+            raise ValueError(
+                f"async plan was built for a different population: "
+                f"plan windows {W} != "
+                f"{acfg.num_windows(ds.num_clients)} for "
+                f"{ds.num_clients} padded clients at "
+                f"M={acfg.buffer_size}"
+            )
+        sh = self.plan.client_sharding()
+        window_dev = global_put(
+            np.asarray(async_plan.window, np.int32), sh
+        )
+        if acfg.schedule == "score":
+            score_dev = global_put(
+                np.asarray(async_plan.score, np.float32), sh
+            )
+        else:
+            # Replicated zero placeholder (spec rep): keeps the program
+            # signature uniform without shipping a per-client array.
+            score_dev = jnp.float32(0.0)
+        max_stale = (float(acfg.max_staleness)
+                     if acfg.max_staleness is not None
+                     else async_rounds._NO_MAX_STALENESS)
+        extras = ()
+        if attack_scale is not None:
+            extras += (attack_scale,)
+        if defense is not None:
+            clip = defense.clip_norm
+            if clip is None or not np.isfinite(clip):
+                clip = 3.0e38  # finite sentinel — see the sync path note
+            extras += (jnp.float32(clip), jnp.float32(defense.trim_fraction))
+        key = async_rounds.async_variant_key(
+            W, acfg.schedule, attack_scale is not None, defense
+        )
+        fn = self._round_step_variants.get(key)
+        if fn is None:
+            fn = async_rounds.build_async_round_step(
+                self, W, acfg.schedule,
+                with_attack=attack_scale is not None, defense=defense,
+            )
+            self._round_step_variants[key] = fn
+        return fn, (
+            state, ds.x, ds.y, ds.num_samples, num_steps, ds.client_uid,
+            weight, window_dev, score_dev,
+            jnp.float32(acfg.staleness_alpha), jnp.float32(max_stale),
+            *extras,
         )
 
     def lower_round_step(self, *args, **kwargs):

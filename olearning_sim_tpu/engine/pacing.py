@@ -243,6 +243,28 @@ def select_cohort(
     return out
 
 
+def arrival_ranks(
+    completion: np.ndarray,
+    selected: np.ndarray,
+) -> np.ndarray:
+    """[C] int32 dense arrival ranks over the selected cohort: 0 for the
+    earliest simulated completion, 1 for the next, ...; -1 for
+    non-selected clients. Ties break by client index (stable argsort), so
+    the order — and everything built on it, e.g. the async engine's
+    commit-window assignment — is deterministic and replays exactly under
+    rollback/resume. Non-finite completions sort last (they still get a
+    rank: whether they commit is the caller's staleness/deadline policy).
+    """
+    completion = np.asarray(completion, np.float32)
+    selected = np.asarray(selected, bool)
+    ranks = np.full(len(completion), -1, np.int32)
+    idx = np.flatnonzero(selected)
+    if len(idx):
+        order = idx[np.argsort(completion[idx], kind="stable")]
+        ranks[order] = np.arange(len(order), dtype=np.int32)
+    return ranks
+
+
 def effective_deadline(
     completion: np.ndarray,
     selected: np.ndarray,

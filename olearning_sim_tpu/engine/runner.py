@@ -35,6 +35,7 @@ import contextlib
 import dataclasses
 import json
 import math
+import os
 import threading
 import time
 import zlib
@@ -144,6 +145,7 @@ class SimulationRunner:
         deadline: Optional[DeadlineConfig] = None,
         defense: Optional[DefenseConfig] = None,
         quarantine_preseed: Optional[Dict[str, List[int]]] = None,
+        async_config: Optional[Any] = None,
     ):
         """``model_io`` — a :class:`ModelUpdateExporter` realizing the
         reference's model-update-style convention (round r's global model
@@ -283,6 +285,32 @@ class SimulationRunner:
             DeadlineController(self.deadline)
             if self.deadline is not None else None
         )
+        # Buffered asynchronous rounds (engine/async_rounds.py): commits
+        # every M arrivals with staleness-weighted aggregation instead of
+        # one deadline-masked commit per round. Mutually exclusive with
+        # deadline masking (max_staleness is the async lateness control)
+        # and with per-client-state algorithms.
+        self.async_config = async_config
+        if self.async_config is not None:
+            if self.deadline is not None:
+                raise ValueError(
+                    "async and deadline configs are mutually exclusive: "
+                    "the buffered engine's lateness control is "
+                    "async.max_staleness (docs/performance.md)"
+                )
+            if core.algorithm.personalized or core.algorithm.control_variates:
+                raise ValueError(
+                    f"async rounds do not support the personalized/"
+                    f"control-variate algorithm {core.algorithm.name!r}"
+                )
+        # Cumulative committed buffer windows across the task (the async
+        # staleness clock). Rides per-round history records -> checkpoint
+        # meta, so rollback/resume replays the commit sequence exactly
+        # (_reasync), like quarantine state and the deadline controller.
+        self._async_commit_clock = 0
+        # run()-loop state for the cooperative stepping API (begin/step/
+        # finish) the MultiTaskDispatcher drives; None outside a run.
+        self._loop: Optional[Dict[str, Any]] = None
 
         if not self.task_repo.has_task(task_id):
             self.task_repo.add_task(task_id)
@@ -423,22 +451,16 @@ class SimulationRunner:
         ).observe(time.perf_counter() - t0)
 
     # -------------------------------------------------------------- operators
-    def _plan_pacing(self, p: DataPopulation, round_idx: int,
-                     operator: OperatorSpec, trace: ClientTrace,
-                     eligible: np.ndarray) -> RoundPacing:
-        """Host-side deadline plan for one (population, round): over-select
-        the cohort, derive each client's simulated completion time (network
-        arrival + device-class compute), and close the round at the earlier
-        of (controller deadline, K-th arrival). Deterministic for a given
-        (config, trace_seed, operator, population, round) — rollback
-        replays reproduce the exact straggler set, while distinct
-        (operator, population) pairs draw decorrelated streams."""
-        cfg = self.deadline
+    def _completion_times(self, p: DataPopulation, round_idx: int,
+                          operator: OperatorSpec, trace: ClientTrace,
+                          cfg) -> np.ndarray:
+        """[real] simulated completion times for one (population, round)
+        under ``cfg``'s completion model (DeadlineConfig or the async
+        config's equivalent), with the ``runner.straggler_spike``
+        injection applied. Shared by the deadline planner and the async
+        round planner — both replay exactly under rollback/resume."""
         real = p.dataset.num_real_clients
         stream = zlib.crc32(f"{operator.name}\x00{p.name}".encode())
-        selected = pacing.select_cohort(
-            eligible, cfg, self.trace_seed, round_idx, stream=stream
-        )
         if p.num_steps is not None:
             steps = np.minimum(
                 np.asarray(p.num_steps[:real], np.int32),
@@ -469,6 +491,25 @@ class SimulationRunner:
             else:
                 idx = [int(c) for c in clients if int(c) < real]
                 completion[idx] = completion[idx] * factor
+        return completion
+
+    def _plan_pacing(self, p: DataPopulation, round_idx: int,
+                     operator: OperatorSpec, trace: ClientTrace,
+                     eligible: np.ndarray) -> RoundPacing:
+        """Host-side deadline plan for one (population, round): over-select
+        the cohort, derive each client's simulated completion time (network
+        arrival + device-class compute), and close the round at the earlier
+        of (controller deadline, K-th arrival). Deterministic for a given
+        (config, trace_seed, operator, population, round) — rollback
+        replays reproduce the exact straggler set, while distinct
+        (operator, population) pairs draw decorrelated streams."""
+        cfg = self.deadline
+        stream = zlib.crc32(f"{operator.name}\x00{p.name}".encode())
+        selected = pacing.select_cohort(
+            eligible, cfg, self.trace_seed, round_idx, stream=stream
+        )
+        completion = self._completion_times(p, round_idx, operator, trace,
+                                            cfg)
         completion = np.where(selected, completion, np.inf).astype(np.float32)
         eff = pacing.effective_deadline(
             completion, selected, cfg, self._pacer.current_deadline()
@@ -513,6 +554,24 @@ class SimulationRunner:
                 ).astype(mask.dtype)
             pace: Optional[RoundPacing] = None
             completion_dev = None
+            aplan = None
+            async_completion = None
+            if self.async_config is not None:
+                # Buffered async rounds: simulate the cohort's arrivals
+                # and assign commit windows in completion-time order.
+                # Deterministic for (config, trace_seed, operator,
+                # population, round) — rollback/resume replays the exact
+                # commit sequence.
+                from olearning_sim_tpu.engine import async_rounds
+
+                async_completion = self._completion_times(
+                    p, round_idx, operator, trace,
+                    self.async_config.pacing_config(),
+                )
+                aplan = async_rounds.plan_async_round(
+                    self.async_config, async_completion, mask[:real] > 0,
+                    p.dataset.num_clients,
+                )
             if self.deadline is not None:
                 pace = self._plan_pacing(p, round_idx, operator, trace,
                                          mask[:real] > 0)
@@ -557,6 +616,8 @@ class SimulationRunner:
             if pace is not None:
                 pace_kwargs = dict(completion_time=completion_dev,
                                    deadline=pace.deadline_s)
+            if aplan is not None:
+                pace_kwargs["async_plan"] = aplan
             atk = self._attacks.get(p.name)
             if atk is not None and atk["scale"] is not None:
                 # Byzantine update attack (sign_flip/scale): the per-client
@@ -602,10 +663,15 @@ class SimulationRunner:
                     )
                     self.control_states[p.name] = control
                 else:
-                    state, metrics = self.core.round_step(
+                    out = self.core.round_step(
                         state, p.dataset, participate=participate,
                         num_steps=num_steps, **pace_kwargs,
                     )
+                    astats = None
+                    if aplan is not None:
+                        state, metrics, astats = out
+                    else:
+                        state, metrics = out
             finally:
                 if clean_y_dev is not None:
                     p.dataset = dataclasses.replace(
@@ -736,6 +802,56 @@ class SimulationRunner:
             # only on rounds that launched — a rolled-back round's
             # observation is discarded with the rest of its state.
             self._pacer.observe(finite)
+            # Tail idle of the synchronous round: every on-time update
+            # waits from its arrival until the single round-close commit.
+            # The async engine's headline claim is driving this to ~0.
+            on_time = pace.completion[
+                np.isfinite(pace.completion)
+                & (pace.completion <= pace.deadline_s)
+            ]
+            idle = float(np.clip(pace.round_close_s() - on_time,
+                                 0.0, None).sum())
+            rec["idle_s"] = round(idle, 6)
+            instrument(
+                "ols_engine_idle_seconds_total", self.registry
+            ).labels(task_id=self.task_id, mode="sync").inc(idle)
+        if aplan is not None:
+            # Buffered-async accounting: commits, staleness, buffer depth
+            # and the committed updates' buffer-wait (idle) — all host-
+            # derivable from the plan plus the program's own stats.
+            commits = int(astats.commits)
+            dropped_stale = int(astats.dropped_stale)
+            committed = int(metrics.clients_trained)
+            self._async_commit_clock += commits
+            idle = aplan.idle_seconds(async_completion)
+            rec.update(
+                commits=commits,
+                committed=committed,
+                stale_dropped=dropped_stale,
+                buffer_size=self.async_config.buffer_size,
+                windows=aplan.num_windows,
+                idle_s=round(idle, 6),
+                commit_clock=self._async_commit_clock,
+            )
+            instrument("ols_engine_buffer_depth", self.registry).labels(
+                task_id=self.task_id
+            ).set(committed / commits if commits else 0.0)
+            # Staleness of a committed client == its commit-window index
+            # (server commits between its dispatch and its commit).
+            committed_mask = (
+                (aplan.window[:real] >= 0)
+                & ~aplan.stale_dropped_mask()[:real]
+                & ok[:real] & (mask[:real] > 0)
+            )
+            if committed_mask.any():
+                instrument(
+                    "ols_engine_staleness_rounds", self.registry
+                ).labels(task_id=self.task_id).observe_many(
+                    aplan.window[:real][committed_mask].astype(np.float64)
+                )
+            instrument(
+                "ols_engine_idle_seconds_total", self.registry
+            ).labels(task_id=self.task_id, mode="async").inc(idle)
         if self.core.algorithm.personalized:
             rec["personal_loss"] = float(metrics.personal_loss)
         return rec
@@ -893,6 +1009,7 @@ class SimulationRunner:
         self.history = history
         self._repace()
         self._requarantine()
+        self._reasync()
         self.logger.info(
             task_id=self.task_id, system_name="engine", module_name="runner",
             message=f"resumed from checkpoint: round {last_round} complete",
@@ -1001,6 +1118,7 @@ class SimulationRunner:
         }
         self.history = list(snap["history"])
         self._repace()
+        self._reasync()
         if self._quarantine is not None and snap["quarantine"] is not None:
             self._quarantine.restore(snap["quarantine"])
 
@@ -1011,6 +1129,21 @@ class SimulationRunner:
         replayed rounds see exactly the deadlines they originally saw."""
         if self._pacer is not None:
             self._pacer.load_from_history(self.history)
+
+    def _reasync(self) -> None:
+        """Rehydrate the async commit clock from the history just restored
+        (rollback or checkpoint resume): the newest record carrying an
+        ``async_clock`` holds the cumulative commit count as of that
+        round's completion, so replays continue the sequence instead of
+        double-counting commits."""
+        if self.async_config is None:
+            return
+        for rec in reversed(self.history):
+            clock = rec.get("async_clock")
+            if clock is not None:
+                self._async_commit_clock = int(clock)
+                return
+        self._async_commit_clock = 0
 
     def _requarantine(self) -> None:
         """Rehydrate quarantine (defense) state from the history just
@@ -1404,6 +1537,11 @@ class SimulationRunner:
             # a supervisor-relaunched task replays quarantine decisions
             # bitwise (_requarantine), not just in-process rollbacks.
             round_record["quarantine_state"] = self._quarantine.state_json()
+        if self.async_config is not None:
+            # The async commit clock (cumulative committed buffer windows)
+            # rides checkpoint meta the same way, so a resumed run reports
+            # a continuous commit sequence (_reasync).
+            round_record["async_clock"] = self._async_commit_clock
         self.history.append(round_record)
         # A preemption here ("runner.pre_checkpoint") dies with the round's
         # work done but not yet durable — the classic lost-round scenario the
@@ -1443,7 +1581,13 @@ class SimulationRunner:
             return "final"
         return "ok"
 
-    def run(self) -> List[Dict[str, Any]]:
+    def begin(self) -> None:
+        """Arm the cooperative round loop: materialize per-population
+        state, resume (checkpoint / exported model / warm start), and set
+        the loop cursor. ``run()`` is exactly ``begin(); while step():
+        pass; finish()`` — the stepping API is what lets a
+        :class:`MultiTaskDispatcher` interleave several tasks' compiled
+        round programs on one process."""
         for p in self.populations:
             if p.name not in self.states:
                 # crc32, not hash(): str hashes are PYTHONHASHSEED-randomized
@@ -1467,94 +1611,392 @@ class SimulationRunner:
         )
         if self._quarantine is not None:
             self._qsnapshots[start_round - 1] = self._quarantine.snapshot()
-        round_idx = start_round
         # Retry budget is PER ROUND (not a running counter): a rollback that
         # resumes earlier than the failed round replays intervening rounds
         # successfully, and those successes must not refill the budget of a
         # deterministically failing round (infinite replay loop otherwise).
-        retries: Dict[int, int] = {}
-        # Monotonic per-rollback epoch for deviceflow routing-key suffixes:
-        # any round executed as a replay needs a key its earlier execution
-        # never used, or it joins a flow still awaiting the release loop.
-        flow_epoch = 0
-        while round_idx < self.rounds:
-            if self.stop_event is not None and self.stop_event.is_set():
-                # Cooperative stop between rounds (reference analogue:
-                # stopTask -> Ray job stop, ``task_manager.py:358-455``).
-                self.stopped = True
-                break
-            if snapshotting and (
-                self._round_snapshot is None
-                or self._round_snapshot["round_idx"] != round_idx
-            ):
-                self._round_snapshot = self._capture_snapshot(round_idx)
-            replaying = (round_idx <= self._force_checkpoint_until
-                         or retries.get(round_idx, 0) > 0)
-            try:
-                faults.inject("runner.round_begin", context=str(round_idx),
-                              round_idx=round_idx, task_id=self.task_id)
-                self._maybe_poison(round_idx)
-                self._maybe_attack(round_idx)
-                status = self._execute_round(
-                    round_idx, flow_epoch if replaying else 0
-                )
-            except (KeyboardInterrupt, SystemExit):
+        # flow_epoch: monotonic per-rollback epoch for deviceflow
+        # routing-key suffixes — any round executed as a replay needs a key
+        # its earlier execution never used, or it joins a flow still
+        # awaiting the release loop.
+        self._loop = {
+            "round_idx": start_round,
+            "retries": {},
+            "flow_epoch": 0,
+            "snapshotting": snapshotting,
+            "done": False,
+        }
+
+    def step(self) -> bool:
+        """Execute at most one round (including its failure-policy
+        dispatch); returns True while more rounds remain. An exception
+        escaping means the task failed under its failure policy."""
+        lp = self._loop
+        if lp is None:
+            raise RuntimeError("SimulationRunner.step() before begin()")
+        if lp["done"] or lp["round_idx"] >= self.rounds:
+            lp["done"] = True
+            return False
+        round_idx = lp["round_idx"]
+        if self.stop_event is not None and self.stop_event.is_set():
+            # Cooperative stop between rounds (reference analogue:
+            # stopTask -> Ray job stop, ``task_manager.py:358-455``).
+            self.stopped = True
+            lp["done"] = True
+            return False
+        if lp["snapshotting"] and (
+            self._round_snapshot is None
+            or self._round_snapshot["round_idx"] != round_idx
+        ):
+            self._round_snapshot = self._capture_snapshot(round_idx)
+        replaying = (round_idx <= self._force_checkpoint_until
+                     or lp["retries"].get(round_idx, 0) > 0)
+        try:
+            faults.inject("runner.round_begin", context=str(round_idx),
+                          round_idx=round_idx, task_id=self.task_id)
+            self._maybe_poison(round_idx)
+            self._maybe_attack(round_idx)
+            status = self._execute_round(
+                round_idx, lp["flow_epoch"] if replaying else 0
+            )
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — policy dispatch
+            from olearning_sim_tpu.telemetry import instrument
+
+            instrument("ols_engine_rounds_total", self.registry).labels(
+                task_id=self.task_id, status="failed"
+            ).inc()
+            self._abandon_live_flow()
+            action, next_round, new_attempts = self._handle_round_failure(
+                round_idx, lp["retries"].get(round_idx, 0), e
+            )
+            if action == "raise":
+                self._persist_resilience()
                 raise
-            except Exception as e:  # noqa: BLE001 — policy dispatch
-                from olearning_sim_tpu.telemetry import instrument
+            lp["retries"][round_idx] = new_attempts
+            lp["round_idx"] = next_round
+            lp["flow_epoch"] += 1
+            return True
+        lp["retries"].pop(round_idx, None)
+        # "ok" means the round's work completed: always true for
+        # "ok"/"final"; true for "stop" only when the stop barrier was
+        # abandoned AFTER the operators ran (history got the record) —
+        # a stop at the START barrier executed nothing and counts as
+        # no round at all.
+        if status != "stop" or (
+            self.history and self.history[-1].get("round") == round_idx
+        ):
+            from olearning_sim_tpu.telemetry import instrument
 
-                instrument("ols_engine_rounds_total", self.registry).labels(
-                    task_id=self.task_id, status="failed"
-                ).inc()
-                self._abandon_live_flow()
-                action, next_round, new_attempts = self._handle_round_failure(
-                    round_idx, retries.get(round_idx, 0), e
-                )
-                if action == "raise":
-                    self._persist_resilience()
-                    raise
-                retries[round_idx] = new_attempts
-                round_idx = next_round
-                flow_epoch += 1
-                continue
-            retries.pop(round_idx, None)
-            # "ok" means the round's work completed: always true for
-            # "ok"/"final"; true for "stop" only when the stop barrier was
-            # abandoned AFTER the operators ran (history got the record) —
-            # a stop at the START barrier executed nothing and counts as
-            # no round at all.
-            if status != "stop" or (
-                self.history and self.history[-1].get("round") == round_idx
-            ):
-                from olearning_sim_tpu.telemetry import instrument
+            instrument("ols_engine_rounds_total", self.registry).labels(
+                task_id=self.task_id, status="ok"
+            ).inc()
+        if self._quarantine is not None:
+            self._qsnapshots[round_idx] = self._quarantine.snapshot()
+            # Retention must cover the deepest possible rollback: a
+            # preemption can fall back across every retained checkpoint
+            # step — max_to_keep steps spaced checkpoint_every rounds
+            # apart — and _rollback then needs the quarantine state as
+            # of the resume round's entry.
+            keep = max(
+                8,
+                getattr(self.checkpointer, "max_to_keep", 0)
+                * max(1, self.checkpoint_every) + 2,
+            ) if self.checkpointer is not None else 8
+            for k in [k for k in self._qsnapshots
+                      if k < round_idx - keep]:
+                del self._qsnapshots[k]
+        if status == "stop":
+            self.stopped = True
+            lp["done"] = True
+            return False
+        if status == "final":
+            lp["done"] = True
+            return False
+        lp["round_idx"] = round_idx + 1
+        if lp["round_idx"] >= self.rounds:
+            lp["done"] = True
+            return False
+        return True
 
-                instrument("ols_engine_rounds_total", self.registry).labels(
-                    task_id=self.task_id, status="ok"
-                ).inc()
-            if self._quarantine is not None:
-                self._qsnapshots[round_idx] = self._quarantine.snapshot()
-                # Retention must cover the deepest possible rollback: a
-                # preemption can fall back across every retained checkpoint
-                # step — max_to_keep steps spaced checkpoint_every rounds
-                # apart — and _rollback then needs the quarantine state as
-                # of the resume round's entry.
-                keep = max(
-                    8,
-                    getattr(self.checkpointer, "max_to_keep", 0)
-                    * max(1, self.checkpoint_every) + 2,
-                ) if self.checkpointer is not None else 8
-                for k in [k for k in self._qsnapshots
-                          if k < round_idx - keep]:
-                    del self._qsnapshots[k]
-            if status == "stop":
-                self.stopped = True
-                break
-            if status == "final":
-                break
-            round_idx += 1
+    def finish(self) -> List[Dict[str, Any]]:
+        """Close out a run: block on the async checkpoint commit, persist
+        the resilience digest, and return the history."""
         if self.checkpointer is not None:
             # Orbax saves are async; block until the last step is durably
             # committed so a process exit right after run() can't lose it.
             self.checkpointer.wait()
         self._persist_resilience()
+        self._loop = None
         return self.history
+
+    def pending_device_rounds(self) -> int:
+        """Device-rounds this task still has to commit (remaining rounds x
+        total real population) — the MultiTaskDispatcher's fair-share
+        currency."""
+        nxt = self._loop["round_idx"] if self._loop is not None else 0
+        remaining = max(0, self.rounds - nxt)
+        return remaining * sum(
+            p.dataset.num_real_clients for p in self.populations
+        )
+
+    def run(self) -> List[Dict[str, Any]]:
+        self.begin()
+        while self.step():
+            pass
+        return self.finish()
+
+
+class MultiTaskDispatcher:
+    """Multiplex several tasks' compiled round programs on one process.
+
+    One engine process historically ran one task and idled between its
+    rounds' host-side phases (trace compile, accounting, checkpoint IO).
+    The dispatcher drives several :class:`SimulationRunner`\\ s at once
+    ("Optimal Task Assignment to Heterogeneous FL Devices",
+    arxiv 2010.00239 motivates multi-task sharing of one accelerator):
+
+    - ``interleave="step"`` (default): deterministic cooperative
+      round-robin through the runners' ``begin()/step()/finish`` API —
+      each turn advances ONE round of one task. With ``fair_share=True``
+      the task with the most *pending device-rounds* goes next
+      (deficit-style fairness: big tasks cannot be starved by small
+      ones); otherwise strict rotation. Per-task results are bitwise
+      those of solo runs — task states are independent and the
+      interleaving order never enters any task's math
+      (tests/test_async.py asserts this).
+    - ``interleave="thread"``: each task runs its full round loop on its
+      own thread, so one task's host-side phases overlap another's
+      device compute and the device queue stays fed between programs —
+      the measured aggregate-throughput win banked in BENCH_async.json.
+
+    Leases (PR 4 supervision, reused): given a ``task_repo`` with lease
+    columns, the dispatcher claims each task's lease at start, renews it
+    as a heartbeat (every turn in step mode; a daemon in thread mode),
+    releases on finish, and FENCES a task whose renewal fails — another
+    process (e.g. a TaskSupervisor that saw the lease expire) owns it
+    now, so the local run stops and cedes the row, exactly like
+    TaskManager's heartbeat fencing. A fenced task's checkpointed rounds
+    stay durable; the reclaimer resumes from them.
+    """
+
+    def __init__(self, runners: List[SimulationRunner], *,
+                 task_repo: Optional[TaskTableRepo] = None,
+                 owner_id: Optional[str] = None,
+                 lease_ttl_s: float = 30.0,
+                 fair_share: bool = True,
+                 interleave: str = "step",
+                 logger: Optional[Logger] = None):
+        if interleave not in ("step", "thread"):
+            raise ValueError(
+                f"interleave must be 'step' or 'thread', got {interleave!r}"
+            )
+        ids = [r.task_id for r in runners]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate task ids in dispatcher: {ids}")
+        self.runners = list(runners)
+        self.task_repo = task_repo
+        self.owner_id = owner_id or f"dispatcher-{os.getpid()}"
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.fair_share = bool(fair_share)
+        self.interleave = interleave
+        self.logger = logger if logger is not None else Logger()
+        # Task ids dropped mid-run because another process took their
+        # lease (inspect after run(); their histories are NOT returned —
+        # the new owner's are the ones of record).
+        self.fenced: List[str] = []
+
+    # ------------------------------------------------------------- leases
+    def _claim(self, runner: SimulationRunner) -> bool:
+        if self.task_repo is None:
+            return True
+        if not self.task_repo.has_task(runner.task_id):
+            self.task_repo.add_task(runner.task_id)
+        return self.task_repo.claim_lease(
+            runner.task_id, self.owner_id, self.lease_ttl_s
+        )
+
+    def _renew(self, runner: SimulationRunner) -> bool:
+        if self.task_repo is None:
+            return True
+        return self.task_repo.renew_lease(
+            runner.task_id, self.owner_id, self.lease_ttl_s
+        )
+
+    def _release(self, runner: SimulationRunner) -> None:
+        if self.task_repo is not None:
+            self.task_repo.release_lease(runner.task_id, self.owner_id)
+
+    def _fence(self, runner: SimulationRunner) -> None:
+        """Another process owns the task now: stop locally, cede the row
+        (no release — the lease belongs to the new owner)."""
+        self.fenced.append(runner.task_id)
+        self.logger.warning(
+            task_id=runner.task_id, system_name="engine",
+            module_name="dispatcher",
+            message="lease renewal failed; fencing task (another process "
+                    "reclaimed it)",
+        )
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Drive every task to completion; returns task_id -> history for
+        the tasks this process finished (fenced tasks excluded)."""
+        if self.interleave == "thread":
+            return self._run_threaded()
+        return self._run_cooperative()
+
+    def _pick(self, active: List[SimulationRunner],
+              rotation: int) -> SimulationRunner:
+        if not self.fair_share:
+            return active[rotation % len(active)]
+        # Deficit fairness: the task with the most pending device-rounds
+        # goes next; ties break by list order (deterministic).
+        return max(active, key=lambda r: r.pending_device_rounds())
+
+    def _run_cooperative(self) -> Dict[str, List[Dict[str, Any]]]:
+        active: List[SimulationRunner] = []
+        results: Dict[str, List[Dict[str, Any]]] = {}
+        errors: Dict[str, BaseException] = {}
+        for r in self.runners:
+            if not self._claim(r):
+                self._fence(r)
+                continue
+            try:
+                r.begin()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — reported below
+                # Same isolation as step/finish errors: in threaded mode
+                # begin() runs inside the worker's try, so a task that
+                # can't even start must not abandon its co-tasks here
+                # either. Lease left to TTL-expire for the supervisor.
+                errors[r.task_id] = e
+                continue
+            active.append(r)
+        rotation = 0
+        while active:
+            # Renew EVERY active task's lease each turn, not just the
+            # picked one: one compile-dominated step on task A must not
+            # let healthy task B's lease TTL-expire and hand it to the
+            # supervisor mid-run (this is the cooperative analogue of
+            # the threaded mode's heartbeat thread).
+            for other in list(active):
+                if not self._renew(other):
+                    active.remove(other)
+                    self._fence(other)
+            if not active:
+                break
+            r = self._pick(active, rotation)
+            rotation += 1
+            try:
+                more = r.step()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — reported below
+                # Per-task error isolation, matching _run_threaded: one
+                # task failing under its failure policy must not abandon
+                # the other tasks mid-run (their finish()/checkpoint
+                # commit and lease release still happen). The failed
+                # task's lease is left to TTL-expire so the supervisor
+                # owns its disposition, same as a failed thread.
+                active.remove(r)
+                errors[r.task_id] = e
+                continue
+            if not more:
+                active.remove(r)
+                try:
+                    results[r.task_id] = r.finish()
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e:  # noqa: BLE001 — reported below
+                    # finish() (checkpoint-commit wait, resilience
+                    # persistence) failing for one task must not abandon
+                    # the others mid-run — threaded mode runs finish()
+                    # inside the worker's try. No release: the lease
+                    # TTL-expires so the supervisor owns disposition.
+                    errors[r.task_id] = e
+                    continue
+                self._release(r)
+        if errors:
+            for tid, e in errors.items():
+                self.logger.error(
+                    task_id=tid, system_name="engine",
+                    module_name="dispatcher",
+                    message=f"task failed under dispatch: "
+                            f"{type(e).__name__}: {e}",
+                )
+            raise next(iter(errors.values()))
+        return results
+
+    def _run_threaded(self) -> Dict[str, List[Dict[str, Any]]]:
+        results: Dict[str, List[Dict[str, Any]]] = {}
+        errors: Dict[str, BaseException] = {}
+        started: List[SimulationRunner] = []
+        for r in self.runners:
+            if not self._claim(r):
+                self._fence(r)
+                continue
+            if r.stop_event is None:
+                # Fencing needs a handle to stop a running loop.
+                r.stop_event = threading.Event()
+            started.append(r)
+
+        fenced_ids: set = set()
+
+        def worker(r: SimulationRunner) -> None:
+            try:
+                results[r.task_id] = r.run()
+            except BaseException as e:  # noqa: BLE001 — reported below
+                errors[r.task_id] = e
+
+        threads = [
+            threading.Thread(target=worker, args=(r,),
+                             name=f"dispatch-{r.task_id}", daemon=True)
+            for r in started
+        ]
+        stop_heart = threading.Event()
+
+        def heartbeat() -> None:
+            # Renew every ttl/3 (the TaskManager cadence); a failed
+            # renewal stops that task's loop at the next round boundary.
+            while not stop_heart.wait(max(0.05, self.lease_ttl_s / 3.0)):
+                for r in started:
+                    if r.task_id in fenced_ids or r.task_id in results:
+                        continue
+                    if not self._renew(r):
+                        fenced_ids.add(r.task_id)
+                        self._fence(r)
+                        r.stop_event.set()
+
+        heart = None
+        if self.task_repo is not None:
+            heart = threading.Thread(target=heartbeat,
+                                     name="dispatch-heartbeat", daemon=True)
+            heart.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop_heart.set()
+        if heart is not None:
+            heart.join()
+        for r in started:
+            if r.task_id in fenced_ids:
+                # A fenced task's history is not ours to report — the
+                # reclaimer's run is the one of record.
+                results.pop(r.task_id, None)
+            elif r.task_id in results:
+                self._release(r)
+        if errors:
+            first = next(iter(errors.values()))
+            for tid, e in errors.items():
+                self.logger.error(
+                    task_id=tid, system_name="engine",
+                    module_name="dispatcher",
+                    message=f"task failed under dispatch: "
+                            f"{type(e).__name__}: {e}",
+                )
+            raise first
+        return results

@@ -430,6 +430,17 @@ def build_runner_from_taskconfig(
 
         defense = DefenseConfig.from_dict(params["defense"])
 
+    # Buffered asynchronous rounds ride the same blob
+    # (docs/performance.md):
+    #   {"async": {"buffer_size": 64, "max_staleness": 8,
+    #              "schedule": "polynomial", "staleness_alpha": 0.5,
+    #              "speed_profiles": {"high": 0.05, "low": 0.4}}}
+    async_config = None
+    if params.get("async"):
+        from olearning_sim_tpu.engine.async_rounds import AsyncConfig
+
+        async_config = AsyncConfig.from_dict(params["async"])
+
     # Operator blocklists: {"quarantine": {"preseed": {"data_0": [3, 7]}}}
     # — known-bad device ids quarantined from round 0 (validated again by
     # the runner against the actual population sizes).
@@ -462,4 +473,5 @@ def build_runner_from_taskconfig(
         deadline=deadline,
         defense=defense,
         quarantine_preseed=quarantine_preseed,
+        async_config=async_config,
     )

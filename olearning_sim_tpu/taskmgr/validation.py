@@ -176,6 +176,9 @@ def validate_correctness(request) -> Tuple[bool, str]:
                         # raises AttributeError/KeyError/TypeError from the
                         # parsers — still a validation failure, not a
                         # server error.
+                        from olearning_sim_tpu.engine.async_rounds import (
+                            AsyncConfig,
+                        )
                         from olearning_sim_tpu.engine.defense import (
                             DefenseConfig,
                         )
@@ -189,11 +192,31 @@ def validate_correctness(request) -> Tuple[bool, str]:
                             parse_quarantine_params,
                         )
 
+                        def _algo_traits(op_params):
+                            """(name, personalized, control_variates) of
+                            the operator's algorithm; traits are (False,
+                            False) when the name is unknown — it fails
+                            elsewhere."""
+                            from olearning_sim_tpu.engine.algorithms import (
+                                from_config as algorithm_from_config,
+                            )
+
+                            algo = (op_params.get("algorithm") or {})
+                            name = algo.get("name", "fedavg") \
+                                if isinstance(algo, dict) else "fedavg"
+                            try:
+                                a = algorithm_from_config(name)
+                                return (name, a.personalized,
+                                        a.control_variates)
+                            except Exception:  # noqa: BLE001 — unknown
+                                return name, False, False
+
                         for block, parse in (
                             ("deadline", DeadlineConfig.from_dict),
                             ("defense", DefenseConfig.from_dict),
                             ("fedcore", FedCoreConfig.from_dict),
                             ("quarantine", parse_quarantine_params),
+                            ("async", AsyncConfig.from_dict),
                         ):
                             if not op_params.get(block):
                                 continue
@@ -211,19 +234,7 @@ def validate_correctness(request) -> Tuple[bool, str]:
                                 # anomaly scoring with control-variate
                                 # algorithms at round time; catch the
                                 # combination here instead.
-                                from olearning_sim_tpu.engine.algorithms import (
-                                    from_config as algorithm_from_config,
-                                )
-
-                                algo = (op_params.get("algorithm") or {})
-                                name = algo.get("name", "fedavg") \
-                                    if isinstance(algo, dict) else "fedavg"
-                                try:
-                                    control = algorithm_from_config(
-                                        name
-                                    ).control_variates
-                                except Exception:  # noqa: BLE001 — unknown
-                                    control = False  # algo fails elsewhere
+                                name, _, control = _algo_traits(op_params)
                                 _req(
                                     not control,
                                     f"operator {op.name} defense params "
@@ -232,6 +243,38 @@ def validate_correctness(request) -> Tuple[bool, str]:
                                     f"scoring is not supported with the "
                                     f"control-variate algorithm {name!r} "
                                     f"(use clip_norm only)",
+                                )
+                            if block == "async":
+                                # The buffered engine's lateness control
+                                # is max_staleness; an enabled deadline
+                                # config on the same task is a conflict
+                                # the runner would reject at build time —
+                                # catch it at submit instead.
+                                dl = op_params.get("deadline")
+                                dl_enabled = False
+                                if dl:
+                                    try:
+                                        dl_enabled = DeadlineConfig \
+                                            .from_dict(dl).enabled
+                                    except Exception:  # noqa: BLE001
+                                        dl_enabled = False  # fails above
+                                _req(
+                                    not dl_enabled,
+                                    f"operator {op.name} async params "
+                                    f"invalid: mutually exclusive with an "
+                                    f"enabled deadline config (use "
+                                    f"async.max_staleness as the "
+                                    f"lateness control)",
+                                )
+                                _, personalized, control = _algo_traits(
+                                    op_params
+                                )
+                                _req(
+                                    not (personalized or control),
+                                    f"operator {op.name} async params "
+                                    f"invalid: buffered async rounds do "
+                                    f"not support personalized / "
+                                    f"control-variate algorithms",
                                 )
 
         units = list(request.logicalSimulation.computationUnit.devicesUnit)

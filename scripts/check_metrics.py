@@ -6,8 +6,10 @@ Checks (exit 1 with one line per violation):
 1. Every name in ``telemetry.CATALOG`` matches
    ``ols_<subsystem>_<noun...>_<unit>``: lowercase snake_case, a known
    subsystem, a known unit suffix; counters end in ``_total``; histograms
-   end in a base-unit suffix (``_seconds`` / ``_bytes``, or ``_ratio`` for
-   dimensionless distributions like normalized anomaly scores).
+   end in a base-unit suffix (``_seconds`` / ``_bytes``, ``_ratio`` for
+   dimensionless distributions like normalized anomaly scores, or
+   ``_rounds`` for discrete round/commit-count distributions like async
+   staleness).
 2. No duplicate registrations: a name may be declared once in CATALOG and
    never re-registered with a string literal elsewhere in the package.
 3. Every ``instrument("...")`` call site in the package references a
@@ -83,10 +85,11 @@ def check(catalog=None, pkg=None) -> list:
         if kind == COUNTER and not name.endswith("_total"):
             problems.append(f"{name}: counters must end in _total")
         if kind == HISTOGRAM and parts[-1] not in ("seconds", "bytes",
-                                                   "ratio"):
+                                                   "ratio", "rounds"):
             problems.append(
                 f"{name}: histograms must measure a base unit "
-                f"(_seconds/_bytes, or _ratio for dimensionless)"
+                f"(_seconds/_bytes, _ratio for dimensionless, or _rounds "
+                f"for discrete round/commit counts)"
             )
 
     referenced = {}

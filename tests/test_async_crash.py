@@ -1,0 +1,205 @@
+"""Async chaos harness: an engine worker running BUFFERED ASYNCHRONOUS
+rounds is SIGKILLed mid-buffer (between server commits of a round in
+flight); the supervisor reclaims the orphaned task off the shared sqlite
+task table and relaunches it through the checkpoint resume path. The
+resumed run must replay the IDENTICAL commit sequence — same per-round
+commit counts, a continuous staleness clock (``async_clock`` rides
+checkpoint meta), and a final global model bitwise equal to an
+uninterrupted run.
+
+Why this holds: the compiled async round program executes ALL of a
+round's buffer commits inside one jit launch, so a crash can only land
+between durably committed rounds — the buffer never persists half-full.
+The checkpoint holds the last committed server version; ``_reasync``
+rehydrates the commit clock from history meta; and the round plan
+(window assignments, arrival order) is a pure function of (config,
+trace_seed, operator, population, round), so the replay is bitwise.
+
+Structure mirrors tests/test_crash_harness.py (the PR 4 sync harness);
+``python test_async_crash.py child <db> <ckpt> <id>`` plays the worker.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TASK_ID = "async-crash-task"
+ROUNDS = 30
+# Buffer 8 over 24 clients = 3 commit windows per round: the kill window
+# spans rounds whose in-flight buffers are mid-sequence.
+ASYNC_PARAMS = {"buffer_size": 8, "schedule": "polynomial",
+                "staleness_alpha": 0.5, "default_step_s": 0.1,
+                "jitter": 0.2}
+
+
+def _task_json(ckpt_dir, with_checkpoint=True):
+    from test_taskmgr import make_task_json
+
+    js = make_task_json(TASK_ID, rounds=ROUNDS)
+    op = js["operatorflow"]["operators"][0]["logical_simulation"]
+    params = json.loads(op["operator_params"])
+    params["async"] = dict(ASYNC_PARAMS)
+    if with_checkpoint:
+        params["checkpoint"] = {"directory": ckpt_dir, "every": 1,
+                                "max_to_keep": 3}
+    op["operator_params"] = json.dumps(params)
+    return js
+
+
+def _child(db_path, ckpt_dir, task_id):
+    from test_taskmgr import make_task_json  # noqa: F401 — path sanity
+
+    from olearning_sim_tpu.engine.task_bridge import (
+        build_runner_from_taskconfig,
+    )
+    from olearning_sim_tpu.taskmgr.status import TaskStatus
+    from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+
+    js = _task_json(ckpt_dir)
+    repo = TaskTableRepo(sqlite_path=db_path)
+    repo.add_task(task_id, task_status=TaskStatus.RUNNING.name,
+                  user_id="user1")
+    repo.set_item_value(task_id, "task_params", json.dumps(js))
+    repo.set_item_value(task_id, "resource_occupied", "1")
+    repo.set_item_value(task_id, "job_id", f"job-{task_id}")
+    # Short lease, never renewed: dead the moment the kill lands.
+    repo.claim_lease(task_id, f"worker:{os.getpid()}", ttl_s=1.0)
+    runner = build_runner_from_taskconfig(json.dumps(js), task_repo=repo)
+    assert runner.async_config is not None  # the async engine is on
+    orig = runner._execute_round
+
+    def slowed(round_idx, attempt=0):
+        time.sleep(0.15)  # widen the kill window; sleep changes no math
+        return orig(round_idx, attempt)
+
+    runner._execute_round = slowed
+    print(f"READY {os.getpid()}", flush=True)
+    runner.run()
+    print("DONE", flush=True)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_mid_buffer_supervisor_resumes_commit_sequence_bitwise(
+        tmp_path):
+    from test_taskmgr import wait_for
+
+    from olearning_sim_tpu.engine.task_bridge import (
+        build_runner_from_taskconfig,
+    )
+    from olearning_sim_tpu.resilience import (
+        LEASE_EXPIRED,
+        TASK_RESUMED,
+        ResilienceLog,
+    )
+    from olearning_sim_tpu.supervisor import TaskSupervisor
+    from olearning_sim_tpu.taskmgr.status import TaskStatus
+    from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+
+    db = str(tmp_path / "tasks.db")
+    ckpt_dir = str(tmp_path / "ck")
+    stderr_path = tmp_path / "child.stderr"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO_ROOT + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+
+    def child_stderr():
+        try:
+            return stderr_path.read_text()[-4000:]
+        except OSError:
+            return "<no stderr captured>"
+
+    with open(stderr_path, "w") as stderr_file:
+        proc = subprocess.Popen(
+            [sys.executable, __file__, "child", db, ckpt_dir, TASK_ID],
+            env=env, stdout=subprocess.PIPE, stderr=stderr_file, text=True,
+        )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("READY"), \
+            f"worker never came up (got {line!r}); stderr:\n{child_stderr()}"
+        repo = TaskTableRepo(sqlite_path=db)
+        manifest_dir = os.path.join(ckpt_dir, "manifests")
+
+        def committed_steps():
+            try:
+                return [int(n[len("step-"):-len(".json")])
+                        for n in os.listdir(manifest_dir)
+                        if n.startswith("step-") and n.endswith(".json")]
+            except (OSError, ValueError):
+                return []
+
+        def progressed():
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "worker exited before the kill landed — widen the "
+                    f"round sleep or raise ROUNDS; stderr:\n{child_stderr()}"
+                )
+            # Gate the kill on the COMMIT POINT (a durable manifest for
+            # round >= 2) so there is a committed async round to resume
+            # from, then kill while later rounds' buffers are in flight.
+            return any(s >= 2 for s in committed_steps())
+
+        assert wait_for(progressed, timeout=240), "worker made no progress"
+        os.kill(proc.pid, signal.SIGKILL)  # mid-buffer, no cleanup of any kind
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    assert repo.get_item_value(TASK_ID, "task_status") == \
+        TaskStatus.RUNNING.name
+
+    log = ResilienceLog()
+    time.sleep(1.1)  # let the 1s worker lease lapse fully
+    sup = TaskSupervisor(task_repo=repo, lease_ttl=30.0, backoff_base_s=0.0,
+                         log=log)
+    digest = sup.scan_once()
+    assert digest["resumed"] == [TASK_ID]
+    assert log.count(LEASE_EXPIRED, TASK_ID) == 1
+    assert log.count(TASK_RESUMED, TASK_ID) == 1
+    job_id = repo.get_item_value(TASK_ID, "job_id")
+    assert job_id == f"job-{TASK_ID}~s1"
+    assert wait_for(
+        lambda: sup.launcher.get_job_status(job_id) == TaskStatus.SUCCEEDED,
+        timeout=240,
+    ), sup.launcher.get_job(job_id) and sup.launcher.get_job(job_id).error
+    assert sup.scan_once()["finalized"] == [TASK_ID]
+    resumed = sup.launcher.get_job(job_id).runner
+
+    # Baseline: an uninterrupted run of the same task (same task_id =>
+    # same RNG / pacing streams; no checkpointing needed).
+    baseline = build_runner_from_taskconfig(
+        json.dumps(_task_json(ckpt_dir, with_checkpoint=False)),
+        task_repo=TaskTableRepo(),
+    )
+    baseline.run()
+
+    # The commit sequence is identical: restored + replayed rounds stitch
+    # into one contiguous history with the same per-round commit counts
+    # and a continuous cumulative commit clock.
+    assert [h["round"] for h in resumed.history] == list(range(ROUNDS))
+    assert [h["async_clock"] for h in resumed.history] == \
+        [h["async_clock"] for h in baseline.history]
+    assert [h["train"]["data_0"]["commits"] for h in resumed.history] == \
+        [h["train"]["data_0"]["commits"] for h in baseline.history]
+
+    got = jax.tree.leaves(jax.device_get(resumed.states["data_0"].params))
+    want = jax.tree.leaves(jax.device_get(baseline.states["data_0"].params))
+    assert len(got) == len(want)
+    for x, y in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 4 and sys.argv[1] == "child":
+        _child(sys.argv[2], sys.argv[3], sys.argv[4])
