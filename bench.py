@@ -23,7 +23,9 @@ Scale-out modes (docs/performance.md): ``--chips N`` runs every family on
 a mesh over the first N devices (per-chip normalization reads the mesh
 size, not the host's device count); ``--multichip`` banks the
 chips={1,2,4,8} plain+defended scaling family into
-``BENCH_multichip.json``; ``--async`` banks the buffered-async vs
+``BENCH_multichip.json``; ``--modelparallel`` banks the large-model
+tensor-parallel mp={1,2,4} rows (distilbert/vit_tiny/resnet18) into
+``BENCH_modelparallel.json``; ``--async`` banks the buffered-async vs
 sync-deadline pair (committed device-rounds/sec at straggler-heavy
 pacing) plus the 2-task multiplex record into ``BENCH_async.json``. All
 bench processes share the persistent XLA compile cache
@@ -64,7 +66,7 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
                vocab_size=None, seq_len=None, deadline_frac=None,
                attack_frac=None, defense=None, shard_server=False,
                straggler_spike=None, async_buffer=None,
-               async_schedule="polynomial"):
+               async_schedule="polynomial", microbatches=None):
     """One benchmark family: build, warm, time. Returns the record dict.
 
     ``carry``: "bf16" runs local SGD with a bfloat16 params carry (halves
@@ -123,7 +125,8 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
                         shard_server_update=bool(shard_server))
     core = build_fedcore(model, algorithm, plan, cfg,
                          model_overrides=model_overrides,
-                         input_shape=input_shape)
+                         input_shape=input_shape,
+                         microbatches=microbatches)
     if text:
         ds = make_synthetic_text_dataset(
             seed=0, num_clients=num_clients, n_local=n_local,
@@ -246,6 +249,7 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
         "carry": carry or "f32",
         "clients": num_clients,
         "local_steps": local_steps,
+        "timed_rounds": timed_rounds,
         "rounds_per_sec": round(float(rps), 4),
         "device_rounds_per_sec": round(float(rps * num_clients), 1),
         "round_time_sec": round(float(times.mean()), 4),
@@ -280,6 +284,12 @@ def run_family(plan, *, name, model, algorithm, num_clients, n_local,
             "clipped": int(metrics.clipped)}
            if defense is not None else {}),
         **({"shard_server": True} if shard_server else {}),
+        # Model-parallel provenance: the mesh's model axes, when present
+        # (BENCH_modelparallel.json's scaling curves key on these).
+        **({"mp": plan.mp} if plan.mp > 1 else {}),
+        **({"pp": plan.pp,
+            "microbatches": int(microbatches or plan.pp)}
+           if plan.pp > 1 else {}),
     }
 
 
@@ -545,7 +555,8 @@ def main():
     _merge_suite(_with_provenance(headline, HEADLINE_FAMILY, backend,
                                   degraded))
     plan = None if isolate else make_mesh_plan()
-    for nominal in SUITE_FAMILIES:
+    suite_before = _load_suite()
+    for nominal in _suite_order(SUITE_FAMILIES, suite_before):
         fam = dict(nominal)
         if on_cpu:
             fam = {**fam, **CPU_SUITE_SHRINK}
@@ -554,14 +565,23 @@ def main():
                 fam["input_shape"] = (32,)
         if carry_env:
             fam = {**fam, "carry": "bf16"}
-        # Per-family floor: a family needs compile + >=1 timed round; on
-        # the shrunk CPU shapes that's 1-4 min. Skipping with a recorded
-        # reason beats being killed mid-family with nothing written.
+        # Per-family need: the family's OWN measured cost when it has a
+        # banked record (compile + rounds + margin), else the generic
+        # floor (compile + >=1 timed round; 1-4 min on the shrunk CPU
+        # shapes). Skipping with the recorded estimate beats being killed
+        # mid-family with nothing written — and because never-banked
+        # families were ordered first, a skip here only ever costs a
+        # RE-capture, not a family's first measurement.
         left = _remaining(budget)
-        if left < int(os.environ.get("OLS_BENCH_FAMILY_FLOOR", "240")):
+        floor = int(os.environ.get("OLS_BENCH_FAMILY_FLOOR", "240"))
+        est = _family_cost_estimate(fam["name"], suite_before,
+                                    backend=backend)
+        need = max(floor, est) if est is not None else floor
+        if left < need:
             record = {"family": fam["name"],
                       "skipped": f"wall-clock budget ({budget}s) exhausted "
-                                 f"({left:.0f}s left)"}
+                                 f"({left:.0f}s left, needs ~{need:.0f}s)",
+                      "estimated_cost_s": round(need, 1)}
         else:
             try:
                 record = (run_family_subprocess(
@@ -617,22 +637,63 @@ def _bank(obj, path_or_name):
     return path
 
 
+def _suite_path():
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_suite.json"
+    )
+
+
+def _load_suite(path=None):
+    path = path or _suite_path()
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except Exception:  # noqa: BLE001 — a corrupt file must not stop the bench
+            pass
+    return []
+
+
+def _suite_order(families, suite=None):
+    """Never-yet-banked families run BEFORE re-captures of existing
+    records (stable within each group). Round 5's tail starved
+    distilbert+vit every single round: the cheap head families re-captured
+    numbers they already had until the shared budget ran out, so the two
+    families with NO record never got a turn. A family counts as banked
+    only when its suite entry carries a real measurement."""
+    suite = _load_suite() if suite is None else suite
+    banked = {e.get("family") for e in suite if "rounds_per_sec" in e}
+    return sorted(families, key=lambda f: f["name"] in banked)
+
+
+def _family_cost_estimate(name, suite=None, backend=None):
+    """Measured wall-cost (seconds) of this family's last banked record:
+    compile + (timed + warmup) rounds, plus subprocess startup margin.
+    None when the family has never been measured — or when the banked
+    record was measured on a DIFFERENT backend than this run (``backend``
+    given): a degraded-CPU distilbert estimate (~30 min) would skip the
+    ~1 min TPU re-capture, and a TPU estimate would green-light a CPU
+    family into a mid-family timeout kill."""
+    suite = _load_suite() if suite is None else suite
+    e = {r.get("family"): r for r in suite}.get(name)
+    if not e or "rounds_per_sec" not in e:
+        return None
+    if backend is not None and e.get("backend") != backend:
+        return None
+    rounds = int(e.get("timed_rounds", 2)) + 1  # +1 warmup
+    return float(e.get("compile_sec", 0.0)) \
+        + float(e.get("round_time_sec", 0.0)) * rounds + 30.0
+
+
 def _merge_suite(record, path=None):
     """Merge one family record into BENCH_suite.json keyed by family name.
 
     Non-degraded entries are never overwritten by degraded ones for the
     same family (a CPU-fallback sweep must not clobber a banked TPU
     number); fresher same-or-better provenance replaces."""
-    path = path or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_suite.json"
-    )
-    suite = []
-    if os.path.exists(path):
-        try:
-            with open(path) as f:
-                suite = json.load(f)
-        except Exception:  # noqa: BLE001 — a corrupt file must not stop the bench
-            suite = []
+    path = path or _suite_path()
+    suite = _load_suite(path)
+
     def rank(e):
         # 3: real-hardware measurement; 2: clean CPU measurement;
         # 1: degraded-but-measured; 0: errored/skipped (no number at all).
@@ -783,11 +844,12 @@ def run_one_inprocess(plan, fam):
     fam = dict(fam)
     fam["algorithm"] = make_algorithm(fam["algorithm"])
     chips = fam.pop("chips", None) or _env_chips()
-    if chips:
+    mp, pp = fam.pop("mp", 1), fam.pop("pp", 1)
+    if chips or mp > 1 or pp > 1:
         # --chips: measure on a subdivided mesh; per-chip normalization
         # reads the record's mesh-derived "chips" field, so the curves
-        # stay honest.
-        plan = _plan_for_chips(chips)
+        # stay honest. mp/pp add the model axes (modelparallel sweep).
+        plan = _plan_for_chips(chips, mp=mp, pp=pp)
     # The global log is process-cumulative; in-process suite runs share one
     # process, so record the delta or family N would inherit families
     # 1..N-1's retries.
@@ -840,18 +902,39 @@ def run_family_once(name):
         sys.exit(4)
 
 
-def _plan_for_chips(chips):
+def _forced_device_grid_env(n):
+    """Child env with exactly ``n`` virtual CPU devices (replaces any
+    existing --xla_force_host_platform_device_count in XLA_FLAGS) — the
+    multichip/modelparallel sweeps use it so a chips/mp-count child's
+    mesh is the real thing on a host with no accelerator."""
+    import re
+
+    env = dict(os.environ)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={int(n)}"
+    ).strip()
+    return env
+
+
+def _plan_for_chips(chips, mp=1, pp=1):
     """Mesh over the first ``chips`` devices (default: all) — the --chips
-    knob that captures scaling curves on one host by subdividing it."""
-    if not chips:
+    knob that captures scaling curves on one host by subdividing it.
+    ``mp``/``pp`` give the mesh its model axes (the modelparallel sweep's
+    knobs): dp becomes ``chips // (mp * pp)``."""
+    if not chips and mp == 1 and pp == 1:
         return make_mesh_plan()
     devices = jax.devices()
-    if len(devices) < int(chips):
+    n = int(chips) if chips else len(devices)
+    if len(devices) < n:
         raise RuntimeError(
             f"--chips {chips}: host exposes only {len(devices)} devices "
             f"(on CPU, set --xla_force_host_platform_device_count)"
         )
-    return make_mesh_plan(devices=devices[: int(chips)])
+    return make_mesh_plan(devices=devices[:n], mp=int(mp), pp=int(pp))
 
 
 def run_one(fam_json, out_path):
@@ -865,7 +948,8 @@ def run_one(fam_json, out_path):
     fam["algorithm"] = make_algorithm(tuple(fam["algorithm"]))
     if fam.get("input_shape") is not None:
         fam["input_shape"] = tuple(fam["input_shape"])
-    plan = _plan_for_chips(fam.pop("chips", None) or _env_chips())
+    plan = _plan_for_chips(fam.pop("chips", None) or _env_chips(),
+                           mp=fam.pop("mp", 1), pp=fam.pop("pp", 1))
     record = run_family(plan, **fam)
     record.setdefault("resilience", _resilience_counters())
     record.setdefault("compile_cache", _cache_counters())
@@ -926,8 +1010,6 @@ MULTICHIP_TIMEOUT_S = int(os.environ.get("OLS_BENCH_MULTICHIP_TIMEOUT",
 def run_multichip(out_name="BENCH_multichip.json"):
     """Capture the chips-scaling family; prints one JSON line per entry
     and banks the whole family atomically."""
-    import re
-
     backend, degraded = select_backend()
     # Scaling curves are a throughput claim: anything that is not real
     # accelerator hardware is a degraded measurement (CPU "chips" share
@@ -943,18 +1025,8 @@ def run_multichip(out_name="BENCH_multichip.json"):
         ):
             fam = {**MULTICHIP_FAMILY, **extra, "chips": chips,
                    "name": f"{MULTICHIP_FAMILY['name']}_{program}_c{chips}"}
-            env = dict(os.environ)
-            if backend == "cpu":
-                # Subdivide one host: the child sees exactly `chips`
-                # virtual CPU devices, so the dp mesh is the real thing.
-                flags = re.sub(
-                    r"--xla_force_host_platform_device_count=\d+", "",
-                    env.get("XLA_FLAGS", ""),
-                ).strip()
-                env["XLA_FLAGS"] = (
-                    f"{flags} "
-                    f"--xla_force_host_platform_device_count={chips}"
-                ).strip()
+            env = (_forced_device_grid_env(chips) if backend == "cpu"
+                   else dict(os.environ))
             record = run_family_subprocess(
                 fam, timeout_s=MULTICHIP_TIMEOUT_S, env=env
             )
@@ -974,6 +1046,90 @@ def run_multichip(out_name="BENCH_multichip.json"):
                  "server update; compare BENCH_tpu.json's 1-chip 0.73 "
                  "rounds/sec headline. CPU entries are degraded "
                  "measurements (methodology: docs/performance.md)."),
+        "entries": entries,
+    }
+    _bank(payload, out_name)
+    return payload
+
+
+# ------------------------------------------------------- modelparallel
+# The large-model mp-scaling family (ISSUE 9 / ROADMAP item 4): the three
+# heavy suite families — the transformer pair that used to be SKIPPED on
+# wall-clock budget plus the 377s-compile resnet — measured at tensor
+# parallelism mp={1,2,4} (dp=1, so the curve isolates the mp axis). On
+# CPU each mp-count child is forced to a matching virtual device grid and
+# the whole family is marked degraded, exactly like the multichip sweep.
+# resnet18 is included deliberately: conv towers shard ~0% under the
+# Megatron tp rules, so its flat curve IS the tp-vs-pp selection guidance
+# of docs/performance.md measured rather than asserted.
+MODELPARALLEL_MP = (1, 2, 4)
+MODELPARALLEL_MODELS = (
+    "fedadam_sent140_distilbert_1k",
+    "ditto_cifar100_vit_tiny_1k",
+    "fedprox_femnist_resnet18_1k",
+)
+MODELPARALLEL_TIMEOUT_S = int(os.environ.get(
+    "OLS_BENCH_MODELPARALLEL_TIMEOUT", "1800"))
+
+# CPU shrink for the mp sweep, harder than CPU_SUITE_SHRINK on the client
+# axis: the models stay FULL SIZE (the compile and per-step tensor shapes
+# ARE the family; distilbert's measured suite record is 664 s compile +
+# 396 s/round at 64 clients — 9 such children would outrun any budget),
+# but the mp curve only needs enough clients to exercise the blocked
+# train/aggregate path, and round time scales with the client count while
+# compile time doesn't.
+MODELPARALLEL_CPU_SHRINK = dict(num_clients=16, n_local=4, batch=4,
+                                local_steps=1, unroll=1, block=4,
+                                timed_rounds=1)
+
+
+def run_modelparallel(out_name="BENCH_modelparallel.json"):
+    """Capture the mp-scaling rows for the large client families; one
+    JSON line per entry, banked atomically like the multichip sweep."""
+    backend, degraded = select_backend()
+    # Scaling curves off real accelerator hardware are degraded
+    # measurements (virtual CPU "chips" share one socket's FLOPs), same
+    # policy as the multichip/async sweeps.
+    degraded = degraded or backend != "tpu"
+    families = {f["name"]: f for f in SUITE_FAMILIES}
+    entries = []
+    for name in MODELPARALLEL_MODELS:
+        nominal = families[name]
+        for mp in MODELPARALLEL_MP:
+            fam = dict(nominal)
+            if backend == "cpu":
+                fam = {**fam, **MODELPARALLEL_CPU_SHRINK}
+                if fam.get("text"):
+                    fam["seq_len"] = 32
+                    fam["input_shape"] = (32,)
+            fam["mp"] = mp
+            # Pin the mesh to exactly mp devices so dp=1 on EVERY backend:
+            # without this, an 8-chip TPU host would run the mp=1 row as
+            # dp=8 and mp=2 as dp=4 x mp=2 — a fixed-8-chip dp-vs-mp
+            # tradeoff, not the documented mp-axis isolation curve.
+            fam["chips"] = mp
+            fam["name"] = f"{name}_mp{mp}"
+            env = (_forced_device_grid_env(mp) if backend == "cpu"
+                   else dict(os.environ))
+            record = run_family_subprocess(
+                fam, timeout_s=MODELPARALLEL_TIMEOUT_S, env=env
+            )
+            record.update(model=nominal["model"], mp_requested=mp,
+                          backend=record.get("backend", backend),
+                          degraded=degraded)
+            record.setdefault("captured_unix", round(time.time(), 1))
+            print(json.dumps(record), flush=True)
+            entries.append(record)
+    payload = {
+        "captured_unix": round(time.time(), 1),
+        "backend": backend,
+        "degraded": degraded,
+        "note": ("rounds/sec at tensor parallelism mp={1,2,4} (dp=1) for "
+                 "the three heavy suite families. distilbert/vit shard "
+                 "their transformer blocks over mp; resnet18's conv "
+                 "towers stay replicated (tp-vs-pp selection guidance: "
+                 "docs/performance.md). CPU entries are degraded "
+                 "measurements on virtual device grids."),
         "entries": entries,
     }
     _bank(payload, out_name)
@@ -1161,6 +1317,8 @@ if __name__ == "__main__":
         run_one(sys.argv[i + 1], sys.argv[sys.argv.index("--out") + 1])
     elif "--multichip" in sys.argv:
         run_multichip()
+    elif "--modelparallel" in sys.argv:
+        run_modelparallel()
     elif "--async" in sys.argv:
         run_async_bench()
     elif "--family" in sys.argv:

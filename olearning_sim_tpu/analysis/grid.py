@@ -32,6 +32,20 @@ from typing import Dict, Iterable, List, Optional, Tuple
 PROGRAMS = ("plain", "deadline", "attack", "defense", "maximal",
             "async", "async_defense")
 
+# Programs audited on the model-parallel (mp=2) sub-grid. Gathering
+# defenses / async are rejected at mp>1 (composition matrix in
+# docs/performance.md), so the mp dimension covers the supported set:
+# plain, deadline, attack, and clip-only defense ("clip" exists only
+# here — at mp=1 clipping is part of the full "defense"/"maximal"
+# programs).
+MP_PROGRAMS = ("plain", "deadline", "attack", "clip")
+
+# Models of the mp sub-grid: the mlp+cnn families prove the
+# replicated-fallback path (tp_param_specs shards nothing -> the program
+# must still meet the SAME budget discipline), distilbert proves the
+# really-sharded tensor-parallel path on the grid's tiny text shapes.
+MP_MODELS = ("mlp2", "cnn4", "distilbert")
+
 # Buffer size for the async grid variants: 16 clients / M=4 -> a 4-window
 # commit scan, so the compiled buffer structure (segment_sum + commit
 # scan) is exercised with real multi-window data.
@@ -43,66 +57,133 @@ NUM_CLASSES = 3
 MODEL = "mlp2"
 MODEL_OVERRIDES = {"hidden": [16], "num_classes": NUM_CLASSES}
 
+# Per-model build shapes for the mp sub-grid (MODEL/MODEL_OVERRIDES stay
+# the mp=1 grid's; mlp2 reuses them via the dict below so the two can
+# never drift).
+GRID_MODELS = {
+    "mlp2": dict(input_shape=INPUT_SHAPE, text=False,
+                 overrides=MODEL_OVERRIDES),
+    "cnn4": dict(input_shape=(8, 8, 3), text=False,
+                 overrides={"features": (4, 4, 8),
+                            "num_classes": NUM_CLASSES}),
+    "distilbert": dict(input_shape=(8,), text=True,
+                       overrides={"vocab_size": 64, "max_len": 8,
+                                  "width": 16, "depth": 2, "heads": 2,
+                                  "mlp_dim": 32, "num_classes": 2}),
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class Variant:
-    """One point of the grid; ``name`` keys budgets.json."""
+    """One point of the grid; ``name`` keys budgets.json. The defaults
+    (mp=1, mlp2) keep every pre-mp budget key byte-identical — the mp=1
+    half of this file IS the PR 8 grid, so an unchanged budgets.json
+    entry is the proof that mp wiring left the mp=1 programs alone."""
 
-    program: str          # one of PROGRAMS
+    program: str          # one of PROGRAMS (mp=1) / MP_PROGRAMS (mp>1)
     shard_server_update: bool
     dp: int
+    mp: int = 1
+    model: str = MODEL
 
     @property
     def name(self) -> str:
-        return (f"{self.program}/shard{int(self.shard_server_update)}"
+        base = (f"{self.program}/shard{int(self.shard_server_update)}"
                 f"/dp{self.dp}")
+        if self.mp > 1:
+            base += f"/mp{self.mp}"
+        if self.model != MODEL:
+            base += f"/{self.model}"
+        return base
 
 
 def variant_grid(dps: Tuple[int, ...] = (1, 2),
-                 programs: Iterable[str] = PROGRAMS) -> List[Variant]:
-    """The full audit grid: programs x shard_server_update x dp."""
+                 programs: Iterable[str] = PROGRAMS,
+                 include_mp: Optional[bool] = None) -> List[Variant]:
+    """The full audit grid: (programs x shard_server_update x dp) at mp=1
+    plus the model-parallel sub-grid (:func:`mp_variant_grid`).
+
+    ``include_mp`` defaults to "only on the unfiltered grid": a caller
+    narrowing ``dps``/``programs`` asked for a subset and must not get
+    the fixed dp=2/mp=2 sub-grid appended behind its back (it could even
+    exceed the host's device count); pass ``include_mp=True``/``False``
+    to override either way."""
+    if include_mp is None:
+        include_mp = tuple(dps) == (1, 2) and tuple(programs) == PROGRAMS
     return [
         Variant(program=p, shard_server_update=s, dp=dp)
         for p in programs
         for s in (False, True)
         for dp in dps
-    ]
+    ] + (mp_variant_grid() if include_mp else [])
 
 
-_CORES: Dict[Tuple[bool, int], tuple] = {}
+def mp_variant_grid(mp: int = 2, dp: int = 2) -> List[Variant]:
+    """The mp>1 sub-grid: the GSPMD-auto round program audited under the
+    same budget discipline as the manual one. Per model: the plain
+    program with both server-update layouts (the mp x shard_server_update
+    composition this PR unlocks), plus deadline/attack/clip with the
+    replicated update for mlp2 — enough to probe every mp-supported
+    program structure without doubling the grid's compile time."""
+    variants = []
+    for model in MP_MODELS:
+        for s in (False, True):
+            variants.append(Variant(program="plain", shard_server_update=s,
+                                    dp=dp, mp=mp, model=model))
+    for p in ("deadline", "attack", "clip"):
+        variants.append(Variant(program=p, shard_server_update=False,
+                                dp=dp, mp=mp, model="mlp2"))
+    return variants
+
+
+_CORES: Dict[Tuple[bool, int, int, str], tuple] = {}
 _ARTIFACTS: Dict[str, Dict] = {}
 
 
-def _core_state_ds(shard: bool, dp: int):
-    """A (core, state, dataset) triple per (shard_server_update, dp),
-    cached — every program variant of that pair reuses one build."""
-    key = (shard, dp)
+def _core_state_ds(shard: bool, dp: int, mp: int = 1, model: str = MODEL):
+    """A (core, state, dataset) triple per (shard_server_update, dp, mp,
+    model), cached — every program variant of that tuple reuses one
+    build."""
+    key = (shard, dp, mp, model)
     if key in _CORES:
         return _CORES[key]
     import jax
 
     from olearning_sim_tpu.engine import build_fedcore, fedavg
-    from olearning_sim_tpu.engine.client_data import make_synthetic_dataset
+    from olearning_sim_tpu.engine.client_data import (
+        make_synthetic_dataset,
+        make_synthetic_text_dataset,
+    )
     from olearning_sim_tpu.engine.fedcore import FedCoreConfig
     from olearning_sim_tpu.parallel.mesh import make_mesh_plan
 
     devices = jax.devices()
-    if len(devices) < dp:
+    if len(devices) < dp * mp:
         raise RuntimeError(
-            f"variant grid needs {dp} devices, have {len(devices)}; set "
-            f"--xla_force_host_platform_device_count (conftest/check_all "
-            f"do this before jax initializes)"
+            f"variant grid needs {dp * mp} devices, have {len(devices)}; "
+            f"set --xla_force_host_platform_device_count (conftest/"
+            f"check_all do this before jax initializes)"
         )
-    plan = make_mesh_plan(devices=devices[:dp], dp=dp, mp=1)
+    spec = GRID_MODELS[model]
+    plan = make_mesh_plan(devices=devices[:dp * mp], dp=dp, mp=mp)
     cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2,
                         shard_server_update=shard)
     core = build_fedcore(
-        MODEL, fedavg(0.1), plan, cfg,
-        model_overrides=dict(MODEL_OVERRIDES), input_shape=INPUT_SHAPE,
+        model, fedavg(0.1), plan, cfg,
+        model_overrides=dict(spec["overrides"]),
+        input_shape=spec["input_shape"],
     )
-    ds = make_synthetic_dataset(
-        0, NUM_CLIENTS, 6, INPUT_SHAPE, NUM_CLASSES
-    ).pad_for(plan, cfg.block_clients).place(plan)
+    if spec["text"]:
+        ds = make_synthetic_text_dataset(
+            seed=0, num_clients=NUM_CLIENTS, n_local=6,
+            seq_len=spec["input_shape"][0], num_classes=2,
+            vocab_size=spec["overrides"]["vocab_size"],
+        )
+    else:
+        ds = make_synthetic_dataset(
+            0, NUM_CLIENTS, 6, spec["input_shape"], NUM_CLASSES
+        )
+    ds = ds.pad_for(plan, cfg.block_clients).place(plan)
     state = core.init_state(jax.random.key(0))
     _CORES[key] = (core, state, ds)
     return _CORES[key]
@@ -176,6 +257,16 @@ def _knob_kwargs(program: str, core, ds, setting: str) -> Dict:
             trim_fraction=0.1 if not b else 0.4,
             anomaly_threshold=4.0,
         )
+    if program == "clip":
+        # The one defense shape an mp>1 mesh supports: streaming L2 delta
+        # clipping, no gather. Both settings keep the defense ENABLED
+        # (clip_norm=None would disable it and correctly resolve to the
+        # plain program — a different variant, not a knob change); the
+        # binding-vs-astronomical pair probes that the norm is data.
+        kwargs["defense"] = DefenseConfig(
+            clip_norm=5.0 if not b else 1.0e9,
+            aggregator="mean",
+        )
     return kwargs
 
 
@@ -185,7 +276,8 @@ def artifacts(variant: Variant) -> Dict:
         return _ARTIFACTS[variant.name]
     import jax
 
-    core, state, ds = _core_state_ds(variant.shard_server_update, variant.dp)
+    core, state, ds = _core_state_ds(variant.shard_server_update, variant.dp,
+                                     variant.mp, variant.model)
 
     kwargs_a = _knob_kwargs(variant.program, core, ds, "a")
     fn_a, args_a = core._prepare_round_args(state, ds, **kwargs_a)
@@ -236,6 +328,8 @@ def artifacts(variant: Variant) -> Dict:
         "variant": variant.name,
         "program": variant.program,
         "dp": variant.dp,
+        "mp": variant.mp,
+        "model": variant.model,
         "shard_server_update": variant.shard_server_update,
         "lowered_a": lowered.as_text(),
         "lowered_b": lowered_b.as_text(),

@@ -102,7 +102,7 @@ if __name__ == "__main__":
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=2"
+            flags + " --xla_force_host_platform_device_count=8"
         ).strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
     sys.exit(main())

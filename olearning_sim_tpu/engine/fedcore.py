@@ -294,6 +294,14 @@ def _flat_pad_leaf(p, multiple: int):
     return flat
 
 
+def _reshard(tree, shardings):
+    """Re-lay a placed pytree under new shardings via a jitted identity —
+    unlike ``jax.device_put`` this also works on multi-host meshes where the
+    target sharding spans non-addressable devices. Values are bitwise
+    unchanged (it lowers to slices/collectives, never recomputes)."""
+    return jax.jit(lambda t: t, out_shardings=shardings)(tree)
+
+
 def _dp_shardable(leaf, dp: int) -> bool:
     """Whether an optimizer-state leaf carries per-coordinate state (flat,
     dp-divisible — shard it) as opposed to a replicated scalar like Adam's
@@ -376,6 +384,7 @@ class FedCore:
         config: FedCoreConfig = FedCoreConfig(),
         param_specs: Any = None,
         apply_aux_fn: Optional[Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]]] = None,
+        pp_train: Optional[Tuple[Any, Optional[int]]] = None,
     ):
         """``param_specs`` — optional PartitionSpec pytree (same treedef as
         the params) sharding model tensors over the mesh ``mp`` axis
@@ -387,7 +396,13 @@ class FedCore:
         forward that also returns a model-sown auxiliary loss (Switch-MoE
         load balancing). When given, local training minimizes
         ``ce + config.aux_loss_weight * aux`` so the router stays balanced
-        in the federated path too (not just under ``ep_train_step``)."""
+        in the federated path too (not just under ``ep_train_step``).
+
+        ``pp_train`` — ``(model, microbatches)`` for a pipeline-parallel
+        mesh plan (``plan.pp > 1``): the per-client train body is then the
+        stage-pipelined program of :mod:`olearning_sim_tpu.engine.
+        pp_rounds` (GPipe microbatching of the dense TextTransformer
+        ``model``). Required iff ``plan.pp > 1``."""
         self.apply_fn = apply_fn
         self.apply_aux_fn = apply_aux_fn
         self.init_params_fn = init_params_fn
@@ -395,6 +410,13 @@ class FedCore:
         self.plan = plan
         self.config = config
         self.param_specs = param_specs
+        self._pp_train = pp_train
+        if plan.pp > 1 and pp_train is None:
+            raise ValueError(
+                "plan has pp > 1 but no pp_train=(model, microbatches) was "
+                "given — the pipelined per-client body needs the dense "
+                "TextTransformer instance (build_fedcore wires this)"
+            )
         if algorithm.personalized and algorithm.control_variates:
             raise ValueError(
                 "personalized and control_variates are mutually exclusive "
@@ -405,24 +427,42 @@ class FedCore:
                 "control_variates needs algorithm.local_lr > 0 (the "
                 "option-II refresh divides by K * local_lr)"
             )
+        # Classification flag, not a code gate: tensor parallelism is
+        # ACTIVE only when the mesh has an mp axis AND at least one leaf
+        # actually shards. The builder dispatch itself keys on
+        # plan.mp > 1 (mp=1 programs never see the auto builder, so
+        # inert/all-replicated specs leave them byte-identical — the
+        # lowering-equality tests in tests/test_modelparallel.py and
+        # tests/test_sharded_engine.py consume this flag as that
+        # invariant's witness).
+        self._tp_active = (
+            param_specs is not None
+            and plan.mp > 1
+            and any(any(s is not None for s in spec) for spec in
+                    jax.tree.leaves(param_specs,
+                                    is_leaf=lambda x: isinstance(x, P)))
+        )
         # Cross-replica sharded server update (arXiv 2004.13336): the
-        # optimizer state lives as flat per-coordinate shards over dp
-        # (O(params/dp) per chip). The PartitionSpec tree is derived once
-        # from the optimizer-state structure so init_state, the shard_map
-        # specs, and checkpoint templates can never disagree on layout.
+        # optimizer state lives as flat per-coordinate shards — over dp at
+        # mp=1 (O(params/dp) per chip, updated inside the manual shard_map
+        # via psum_scatter), and over BOTH (dp, mp) when the mesh has a
+        # model axis (O(params/(dp*mp)) per chip; the whole mp>1 round
+        # program runs in GSPMD-auto land — see _build_round_step_auto —
+        # so the flat (dp, mp) layout is an ordinary sharding constraint).
+        # The PartitionSpec tree is derived once from the optimizer-state
+        # structure so init_state, the program specs, and checkpoint
+        # templates can never disagree on layout.
         self._opt_spec = None
+        self._auto_shard_update = config.shard_server_update and plan.mp > 1
+        self._shard_pad = plan.dp * plan.mp
         if config.shard_server_update:
-            if param_specs is not None:
-                raise ValueError(
-                    "shard_server_update is mutually exclusive with "
-                    "tensor-parallel param_specs (mp > 1): the flat dp "
-                    "coordinate shards would cut across the mp sharding"
-                )
             p_shapes = jax.eval_shape(init_params_fn, jax.random.key(0))
+            flat_spec = P(("dp", "mp")) if self._auto_shard_update else P("dp")
             flat_t = jax.tree.map(
                 lambda p: jax.ShapeDtypeStruct(
                     (pad_to_multiple(
-                        int(np.prod(p.shape, dtype=np.int64)), plan.dp
+                        int(np.prod(p.shape, dtype=np.int64)),
+                        self._shard_pad,
                     ),),
                     p.dtype,
                 ),
@@ -433,10 +473,10 @@ class FedCore:
             # shard_map the same leaves appear shard-local ([D_pad/dp]),
             # where a shape test would misclassify them.
             self._opt_sharded = jax.tree.map(
-                lambda l: _dp_shardable(l, plan.dp), opt_t
+                lambda l: _dp_shardable(l, self._shard_pad), opt_t
             )
             self._opt_spec = jax.tree.map(
-                lambda sharded: P("dp") if sharded else P(),
+                lambda sharded: flat_spec if sharded else P(),
                 self._opt_sharded,
             )
         self._round_step = self._build_round_step()
@@ -470,10 +510,12 @@ class FedCore:
         rep = self.plan.replicated()
         shardings = self._param_shardings()
         if self.config.shard_server_update:
-            # Params stay replicated (eval/export/checkpoint see the normal
-            # tree); the optimizer state is initialized over the FLAT padded
-            # coordinate view and placed sharded over dp — zeros either
-            # way, so the values are bitwise those of the replicated init.
+            # Params stay in the normal tree layout (eval/export/checkpoint
+            # see it; tensor-parallel leaves are placed per param_specs);
+            # the optimizer state is initialized over the FLAT padded
+            # coordinate view and placed sharded over dp (and mp on a
+            # model-parallel mesh) — zeros either way, so the values are
+            # bitwise those of the replicated init.
             mesh = self.plan.mesh
             opt_sh = jax.tree.map(
                 lambda s: NamedSharding(mesh, s), self._opt_spec,
@@ -481,10 +523,12 @@ class FedCore:
             )
             pk, bk = jax.jit(jax.random.split, out_shardings=rep)(rng)
             params = jax.jit(self.init_params_fn, out_shardings=rep)(pk)
+            if shardings is not None:
+                params = _reshard(params, shardings)
 
             def make_opt(params):
                 flat = jax.tree.map(
-                    lambda p: _flat_pad_leaf(p, self.plan.dp), params
+                    lambda p: _flat_pad_leaf(p, self._shard_pad), params
                 )
                 return self.algorithm.server_optimizer.init(flat)
 
@@ -509,11 +553,17 @@ class FedCore:
                 )
 
             return jax.jit(make, out_shardings=rep)(rng)
-        # Tensor-parallel: params placed per spec; the optimizer state is
-        # initialized in a follow-up jit with no out constraint, so GSPMD
-        # shards moments/momenta exactly like the params they track.
+        # Tensor-parallel: params initialized REPLICATED and then resharded
+        # per spec in a separate program (init directly under mp-sharded
+        # out_shardings partitions threefry and draws DIFFERENT values for
+        # row-sharded leaves on 0.4.x — the mp=2 model would not equal the
+        # mp=1 model at round 0). The optimizer state is initialized in a
+        # follow-up jit with no out constraint, so GSPMD shards
+        # moments/momenta exactly like the params they track.
         pk, bk = jax.jit(jax.random.split, out_shardings=rep)(rng)
-        params = jax.jit(self.init_params_fn, out_shardings=shardings)(pk)
+        params = _reshard(
+            jax.jit(self.init_params_fn, out_shardings=rep)(pk), shardings
+        )
         opt_state = jax.jit(self.algorithm.server_optimizer.init)(params)
         return ServerState(
             params=params,
@@ -646,7 +696,8 @@ class FedCore:
         )
 
     def _local_train(self, global_params, x, y, num_samples, num_steps, uid,
-                     base_key, round_idx, server_c=None, ci=None):
+                     base_key, round_idx, server_c=None, ci=None,
+                     varying=True):
         """One client's local training: masked lax.scan over SGD steps.
 
         Per-client RNG stream: fold_in(fold_in(base_key, uid), round) — stable
@@ -680,7 +731,7 @@ class FedCore:
         params, mean_loss = self._masked_sgd(
             global_params, alg.local_optimizer.init(global_params),
             x, y, num_samples, steps_eff, key, persample, penalty_fn=penalty,
-            grad_transform=grad_transform, varying_init=True,
+            grad_transform=grad_transform, varying_init=varying,
         )
         delta = jax.tree.map(jnp.subtract, params, global_params)
         if ci is None:
@@ -760,6 +811,35 @@ class FedCore:
 
         The default variant is byte-identical to the pre-deadline,
         pre-defense program."""
+        if self.plan.pp > 1:
+            # Pipeline-parallel mesh: the per-client body streams
+            # microbatches through the pp stages (engine/pp_rounds.py).
+            # Only the plain program exists — every other variant is
+            # rejected at _prepare_round_args / submit validation.
+            if with_deadline or with_attack or defense is not None:
+                raise ValueError(
+                    "pipeline-parallel (pp>1) rounds support the plain "
+                    "program only (no deadline/attack/defense variants); "
+                    "docs/performance.md has the composition matrix"
+                )
+            from olearning_sim_tpu.engine import pp_rounds
+
+            return pp_rounds.build_pp_round_step(self, *self._pp_train)
+        if self.plan.mp > 1:
+            # Model-parallel mesh: the round program is built in pure
+            # GSPMD-auto land. A shard_map that is manual over dp but AUTO
+            # over an mp axis of size > 1 check-fails XLA 0.4.x's SPMD
+            # partitioner on every lax.scan (while-op operands carry
+            # partial-manual subgroup shardings hlo_sharding_util
+            # rejects), so at mp > 1 dp becomes an ordinary array-sharding
+            # axis and GSPMD inserts ALL collectives — tensor-parallel
+            # ones from param_specs and data-parallel reductions alike.
+            # mp = 1 keeps this manual builder byte-identical to earlier
+            # releases.
+            return self._build_round_step_auto(
+                with_deadline=with_deadline, with_attack=with_attack,
+                defense=defense,
+            )
         plan = self.plan
         cfg = self.config
         alg = self.algorithm
@@ -1185,8 +1265,8 @@ class FedCore:
             return jax.shard_map(
                 shard_body,
                 mesh=mesh,
-                in_specs=(rep, opt_spec, rep, rep, cl, cl, cl, cl, cl, cl,
-                          vp_spec, sc_spec, rep) + extra_specs,
+                in_specs=(rep, opt_spec, rep, rep, cl, cl, cl, cl, cl,
+                          cl, vp_spec, sc_spec, rep) + extra_specs,
                 out_specs=(rep, opt_spec, rep, metrics_specs, vp_spec,
                            sc_spec),
                 axis_names=frozenset({"dp"}),
@@ -1261,6 +1341,408 @@ class FedCore:
                 )
 
         return round_step
+
+    def _build_round_step_auto(self, with_deadline: bool = False,
+                               with_attack: bool = False, defense=None):
+        """The mp>1 round program: same semantics as the manual
+        :meth:`_build_round_step` body, expressed entirely in GSPMD-auto
+        land (one ``jax.jit``, no ``shard_map``).
+
+        Why not the manual program: a shard_map that is manual over ``dp``
+        but auto over an ``mp`` axis of size > 1 check-fails XLA 0.4.x's
+        SPMD partitioner on every ``lax.scan`` (``Check failed:
+        sharding.IsManualSubgroup()`` on the while-op operands), so model
+        parallelism cannot ride through the manual boundary on this
+        runtime. Here clients are an ordinary dp-sharded array axis, model
+        tensors carry the tensor-parallel layout from ``param_specs`` via
+        sharding constraints (params, grads, per-client deltas, and the
+        delta accumulators all pin to the SAME mp shards — no resharding
+        collective between train and aggregate), and GSPMD inserts every
+        collective: the Megatron all-gather/reduce-scatters inside the
+        per-client forward/backward AND the cross-replica delta
+        reductions.
+
+        Supported variants: plain, deadline, attack, and clip-only
+        defense. Gathering defenses (robust aggregators / anomaly
+        scoring) are rejected at :meth:`_prepare_round_args` — their
+        coordinate-sharded layout is built on manual dp collectives
+        (docs/performance.md has the composition matrix). Under
+        ``shard_server_update`` the optimizer runs on flat coordinates
+        sharded over BOTH axes (:meth:`_apply_auto_sharded_update` —
+        O(params/(dp*mp)) resident state per chip)."""
+        plan = self.plan
+        cfg = self.config
+        alg = self.algorithm
+        mesh = plan.mesh
+        dpn = plan.dp
+        shard_update = cfg.shard_server_update
+        personalized = alg.personalized
+        controlled = alg.control_variates
+        if defense is not None and defense.gathers_deltas:
+            raise ValueError(
+                "robust aggregators / anomaly scoring are not supported on "
+                "a model-parallel mesh (mp > 1); use clip_norm only"
+            )
+        trace_key = (with_deadline, with_attack,
+                     defense.structure_key if defense is not None else None)
+
+        wsc = jax.lax.with_sharding_constraint
+        csh = NamedSharding(mesh, P("dp"))
+        specs = self.param_specs
+
+        def pin_params(tree):
+            """Params-shaped tree on the tensor-parallel layout."""
+            if specs is None:
+                return tree
+            return jax.tree.map(
+                lambda v, s: wsc(v, NamedSharding(mesh, s)), tree, specs,
+                is_leaf=lambda s: isinstance(s, P),
+            )
+
+        def pin_clients(tree):
+            """Per-client params-shaped tree [B, ...]: client axis over
+            dp, tensor-parallel leaves additionally over mp."""
+            if specs is None:
+                return jax.tree.map(lambda v: wsc(v, csh), tree)
+            return jax.tree.map(
+                lambda v, s: wsc(v, NamedSharding(mesh, P("dp", *s))),
+                tree, specs,
+                is_leaf=lambda s: isinstance(s, P),
+            )
+
+        # varying typing is a manual-shard_map concern; the auto program
+        # must not ask for it (pvary outside a bound axis is an error on
+        # runtimes that have it).
+        train_fn = functools.partial(self._local_train, varying=False)
+
+        def body(params, opt_state, round_idx, base_key,
+                 x, y, num_samples, num_steps, uid, weight, vparams,
+                 server_c, true_n, *extras):
+            # Trace-time probe (see the manual builder).
+            self.trace_counts[trace_key] = \
+                self.trace_counts.get(trace_key, 0) + 1
+            extras = list(extras)
+            stragglers = jnp.float32(0.0)
+            attack_scale = clip_norm = trim_fraction = None
+            if with_deadline:
+                completion_time, deadline = extras[0], extras[1]
+                del extras[:2]
+                late = completion_time > deadline
+                stragglers = jnp.logical_and(
+                    weight > 0, late
+                ).sum().astype(jnp.float32)
+                weight = jnp.where(late, jnp.zeros_like(weight), weight)
+            if with_attack:
+                attack_scale = extras.pop(0)
+            if defense is not None:
+                clip_norm, trim_fraction = extras[0], extras[1]
+                del extras[:2]
+            params = pin_params(params)
+            c_total = x.shape[0]
+            # One "block" is block_clients PER dp shard, matching the
+            # manual program's per-device peak-memory bound.
+            bcg = cfg.block_clients * dpn
+            if c_total % bcg != 0:
+                raise ValueError(
+                    f"padded client count {c_total} must be a multiple of "
+                    f"block_clients*dp={bcg}; pad the dataset with "
+                    f"ClientDataset.pad_for(plan, block=config.block_clients)"
+                )
+            nb = c_total // bcg
+
+            def blocked(a):
+                return a.reshape((nb, bcg) + a.shape[1:])
+
+            xs = (blocked(x), blocked(y), blocked(num_samples),
+                  blocked(num_steps), blocked(uid), blocked(weight),
+                  jax.tree.map(blocked, vparams)
+                  if (personalized or controlled) else None,
+                  blocked(attack_scale) if with_attack else None)
+
+            # Delta accumulators live on the same mp shards as the params,
+            # so the weighted-sum scan never re-lays model tensors.
+            zero_delta = pin_params(jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            ))
+            init = (zero_delta, jnp.float32(0.0), jnp.float32(0.0),
+                    jnp.float32(0.0), jnp.float32(0.0),
+                    zero_delta if controlled else jnp.float32(0.0))
+            if defense is not None:
+                init = init + (jnp.float32(0.0),)
+
+            def block_step(carry, inp):
+                if defense is not None:
+                    (sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc,
+                     n_clip) = carry
+                else:
+                    sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc = carry
+                    n_clip = None
+                bx, by, bns, bst, buid, bw, bvp, batk = inp
+                if controlled:
+                    deltas, losses, dcis = jax.vmap(
+                        train_fn,
+                        in_axes=(None, 0, 0, 0, 0, 0, None, None, None, 0),
+                    )(params, bx, by, bns, bst, buid, base_key, round_idx,
+                      server_c, bvp)
+                else:
+                    deltas, losses = jax.vmap(
+                        train_fn,
+                        in_axes=(None, 0, 0, 0, 0, 0, None, None),
+                    )(params, bx, by, bns, bst, buid, base_key, round_idx)
+                # Per-client deltas pinned to (dp over clients, mp per
+                # specs) straight out of the vmapped train body.
+                deltas = pin_clients(deltas)
+                if with_attack:
+                    deltas = _attack_deltas(deltas, batk)
+                ok = _finite_client_mask(losses, deltas)
+
+                def gate(d):
+                    return jnp.where(
+                        ok.reshape((-1,) + (1,) * (d.ndim - 1)), d, 0.0
+                    )
+
+                bw_eff = jnp.where(ok, bw, 0.0)
+                if defense is not None:
+                    d32 = jax.tree.map(
+                        lambda d: gate(d.astype(jnp.float32)), deltas
+                    )
+                    d32, too_big = _clip_client_deltas(d32, clip_norm)
+                    n_clip = n_clip + jnp.logical_and(
+                        bw_eff > 0, too_big
+                    ).sum().astype(jnp.float32)
+                    sum_delta = jax.tree.map(
+                        lambda s, d: s + jnp.tensordot(bw_eff, d, axes=(0, 0)),
+                        sum_delta, d32,
+                    )
+                else:
+                    sum_delta = jax.tree.map(
+                        lambda s, d: s + jnp.tensordot(
+                            bw_eff, gate(d.astype(jnp.float32)), axes=(0, 0)
+                        ),
+                        sum_delta, deltas,
+                    )
+                sum_delta = pin_params(sum_delta)
+                sum_w = sum_w + bw_eff.sum()
+                sum_loss = sum_loss + jnp.where(ok, bw * losses, 0.0).sum()
+                count = count + (bw_eff > 0).sum().astype(jnp.float32)
+                if controlled:
+                    active = bw_eff > 0
+
+                    def gate_active(d):
+                        return jnp.where(
+                            active.reshape((-1,) + (1,) * (d.ndim - 1)), d, 0.0
+                        )
+
+                    new_bvp = jax.tree.map(
+                        lambda v, d: v + gate_active(d), bvp, dcis
+                    )
+                    sum_dc = jax.tree.map(
+                        lambda s, d: s + jnp.tensordot(bw_eff, gate(d), axes=(0, 0)),
+                        sum_dc, dcis,
+                    )
+                    ys = (losses, new_bvp)
+                elif personalized:
+                    new_vp, plosses = jax.vmap(
+                        self._personal_train,
+                        in_axes=(0, None, 0, 0, 0, 0, 0, 0, None, None),
+                    )(bvp, params, bx, by, bns, bst, buid, bw > 0,
+                      base_key, round_idx)
+                    okp = jnp.isfinite(plosses)
+                    for d in jax.tree.leaves(new_vp):
+                        okp = jnp.logical_and(
+                            okp,
+                            jnp.isfinite(d.reshape(d.shape[0], -1)).all(axis=1),
+                        )
+                    keep = jnp.logical_or(okp, jnp.logical_not(bw > 0))
+                    new_vp = jax.tree.map(
+                        lambda nv, ov: jnp.where(
+                            keep.reshape((-1,) + (1,) * (nv.ndim - 1)), nv, ov
+                        ),
+                        new_vp, bvp,
+                    )
+                    sum_ploss = sum_ploss + jnp.where(
+                        jnp.logical_and(bw > 0, okp), bw * plosses, 0.0
+                    ).sum()
+                    ys = (losses, new_vp)
+                else:
+                    ys = (losses, None)
+                new_carry = (sum_delta, sum_w, sum_loss, count, sum_ploss,
+                             sum_dc)
+                if defense is not None:
+                    new_carry = new_carry + (n_clip,)
+                return new_carry, ys
+
+            carry, (block_losses, new_vparams) = jax.lax.scan(
+                block_step, init, xs, unroll=min(cfg.block_unroll, nb)
+            )
+            if defense is not None:
+                (sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc,
+                 n_clip) = carry
+            else:
+                sum_delta, sum_w, sum_loss, count, sum_ploss, sum_dc = carry
+                n_clip = jnp.float32(0.0)
+            client_loss = wsc(block_losses.reshape((c_total,)), csh)
+            if personalized or controlled:
+                new_vparams = pin_clients(jax.tree.map(
+                    lambda a: a.reshape((c_total,) + a.shape[2:]), new_vparams
+                ))
+
+            # The sums above already range over every client — the
+            # cross-replica reduction the manual program psums explicitly
+            # is a GSPMD-inserted collective here.
+            denom = jnp.maximum(sum_w, 1e-8)
+            if shard_update:
+                # Flat (dp, mp) coordinate shards straight from the
+                # weighted sum (O(params/(dp*mp)) optimizer state).
+                flat_sh = NamedSharding(mesh, P(("dp", "mp")))
+                delta_flat = jax.tree.map(
+                    lambda s: wsc(
+                        _flat_pad_leaf(s, self._shard_pad), flat_sh
+                    ) / denom,
+                    sum_delta,
+                )
+                new_params, new_opt_state = self._apply_auto_sharded_update(
+                    params, opt_state, delta_flat
+                )
+            else:
+                mean_delta = jax.tree.map(lambda s: s / denom, sum_delta)
+                pseudo_grad = jax.tree.map(
+                    lambda d, p: (-d).astype(p.dtype), mean_delta, params
+                )
+                updates, new_opt_state = alg.server_optimizer.update(
+                    pseudo_grad, opt_state, params
+                )
+                new_params = pin_params(optax.apply_updates(params, updates))
+            new_server_c = None
+            if controlled:
+                frac = count / jnp.maximum(true_n, 1.0)
+                new_server_c = jax.tree.map(
+                    lambda c, s: c + frac * (s / denom), server_c, sum_dc
+                )
+            metrics = RoundMetrics(
+                mean_loss=sum_loss / denom,
+                weight_sum=sum_w,
+                clients_trained=count,
+                client_loss=client_loss,
+                personal_loss=sum_ploss / denom,
+                stragglers=stragglers,
+                anomaly_score=jnp.float32(0.0),
+                clipped=n_clip,
+            )
+            return (new_params, new_opt_state, round_idx + 1, metrics,
+                    new_vparams, new_server_c)
+
+        if controlled:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def round_step(state: ServerState, control: ControlState,
+                           x, y, num_samples, num_steps, uid, weight, true_n,
+                           *extras):
+                (new_params, new_opt_state, new_round, metrics, new_ci,
+                 new_sc) = body(
+                    state.params, state.opt_state, state.round_idx,
+                    state.base_key, x, y, num_samples, num_steps, uid,
+                    weight, control.client_controls, control.server_control,
+                    true_n, *extras,
+                )
+                return (
+                    ServerState(
+                        params=new_params,
+                        opt_state=new_opt_state,
+                        round_idx=new_round,
+                        base_key=state.base_key,
+                    ),
+                    metrics,
+                    ControlState(client_controls=new_ci, server_control=new_sc),
+                )
+        elif personalized:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def round_step(state: ServerState, personal: PersonalState,
+                           x, y, num_samples, num_steps, uid, weight,
+                           *extras):
+                new_params, new_opt_state, new_round, metrics, new_vp, _ = (
+                    body(
+                        state.params, state.opt_state, state.round_idx,
+                        state.base_key, x, y, num_samples, num_steps, uid,
+                        weight, personal.params, None, jnp.float32(0.0),
+                        *extras,
+                    )
+                )
+                return (
+                    ServerState(
+                        params=new_params,
+                        opt_state=new_opt_state,
+                        round_idx=new_round,
+                        base_key=state.base_key,
+                    ),
+                    metrics,
+                    PersonalState(params=new_vp),
+                )
+        else:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def round_step(state: ServerState, x, y, num_samples, num_steps,
+                           uid, weight, *extras):
+                new_params, new_opt_state, new_round, metrics, _, _ = body(
+                    state.params, state.opt_state, state.round_idx,
+                    state.base_key, x, y, num_samples, num_steps, uid,
+                    weight, None, None, jnp.float32(0.0), *extras,
+                )
+                return (
+                    ServerState(
+                        params=new_params,
+                        opt_state=new_opt_state,
+                        round_idx=new_round,
+                        base_key=state.base_key,
+                    ),
+                    metrics,
+                )
+
+        return round_step
+
+    def _apply_auto_sharded_update(self, params, opt_state, delta_flat):
+        """Cross-replica sharded server update on a model-parallel mesh
+        (the mp>1 composition of arXiv 2004.13336): every param leaf is
+        viewed as flat padded coordinates sharded over BOTH mesh axes
+        (``P(("dp", "mp"))`` — O(params/(dp*mp)) resident optimizer state
+        per chip), the elementwise optax update runs on those shards in
+        GSPMD-auto land, and fresh params are restored to their
+        tensor-parallel layout (param_specs) by one gather per leaf.
+        Runs inside the jitted GSPMD-auto round program
+        (``_build_round_step_auto`` — there is no shard_map at mp>1):
+        ``delta_flat`` arrives as the flat mean delta pinned to
+        ``P(("dp", "mp"))`` by a with_sharding_constraint, and GSPMD
+        places the scatter/gather collectives."""
+        mesh = self.plan.mesh
+        wsc = jax.lax.with_sharding_constraint
+        flat_sh = NamedSharding(mesh, P(("dp", "mp")))
+
+        flat_p = jax.tree.map(
+            lambda p: wsc(_flat_pad_leaf(p, self._shard_pad), flat_sh),
+            params,
+        )
+        delta = jax.tree.map(lambda d: wsc(d, flat_sh), delta_flat)
+        pseudo_grad = jax.tree.map(
+            lambda d, p: (-d).astype(p.dtype), delta, flat_p
+        )
+        updates, new_opt_state = self.algorithm.server_optimizer.update(
+            pseudo_grad, opt_state, flat_p
+        )
+        new_flat = optax.apply_updates(flat_p, updates)
+        new_opt_state = jax.tree.map(
+            lambda l, sharded: wsc(l, flat_sh) if sharded else l,
+            new_opt_state, self._opt_sharded,
+        )
+        shardings = self._param_shardings()
+        if shardings is None:
+            shardings = jax.tree.map(
+                lambda _: NamedSharding(mesh, P()), params
+            )
+
+        def unflat(f, p, sh):
+            n = int(np.prod(p.shape, dtype=np.int64))
+            return wsc(f[:n].reshape(p.shape), sh)
+
+        new_params = jax.tree.map(unflat, new_flat, params, shardings)
+        return new_params, new_opt_state
 
     def _client_sharded_like(self, params):
         """Shardings for a per-client tree [C, ...]: client axis over ``dp``,
@@ -1387,6 +1869,17 @@ class FedCore:
             )
         if defense is not None and not defense.enabled:
             defense = None
+        if self.plan.pp > 1 and (
+            deadline is not None or completion_time is not None
+            or attack_scale is not None or defense is not None
+            or async_plan is not None
+        ):
+            raise ValueError(
+                "pipeline-parallel (pp>1) rounds support the plain "
+                "program only: deadline/attack/defense/async do not "
+                "compose with the stage-pipelined per-client body "
+                "(docs/performance.md has the composition matrix)"
+            )
         if async_plan is not None:
             return self._prepare_async_args(
                 state, ds, async_plan, weight, num_steps,
@@ -1400,6 +1893,16 @@ class FedCore:
                 "robust aggregators / anomaly scoring are not supported "
                 "with control-variate algorithms (the SCAFFOLD server "
                 "control consumes the weighted mean); use clip_norm only"
+            )
+        if defense is not None and defense.gathers_deltas \
+                and self.plan.mp > 1:
+            raise ValueError(
+                "robust aggregators / anomaly scoring do not compose with "
+                "a model-parallel mesh (mp > 1): their coordinate-sharded "
+                "layout is built on manual dp collectives the mp>1 "
+                "GSPMD-auto round program cannot host — run mp=1 or use "
+                "clip_norm only (docs/performance.md has the composition "
+                "matrix)"
             )
         extras = ()
         if deadline is not None:
@@ -1477,6 +1980,14 @@ class FedCore:
         AsyncRoundPlan` (see :meth:`_prepare_round_args`)."""
         from olearning_sim_tpu.engine import async_rounds
 
+        if self.plan.mp > 1:
+            raise ValueError(
+                "buffered asynchronous rounds do not compose with a "
+                "model-parallel mesh (mp > 1): the async commit scan is a "
+                "manual-dp shard_map program, which XLA 0.4.x cannot "
+                "partition with a >1 auto mp axis — run the async family "
+                "at mp=1 (docs/performance.md has the composition matrix)"
+            )
         if deadline is not None or completion_time is not None:
             raise ValueError(
                 "async rounds and deadline masking are mutually exclusive "
@@ -1584,7 +2095,74 @@ class FedCore:
 
         return evaluate
 
+    def _build_evaluate_personal_auto(self):
+        """Ditto personal eval on a model-parallel mesh: same blocked
+        weighted-mean computation as the manual builder below, in pure
+        GSPMD-auto land (the manual shard_map cannot compile at mp>1 —
+        see _build_round_step_auto)."""
+        block = self.config.block_clients * self.plan.dp
+        apply_fn = self.apply_fn
+
+        def make(vp_tree):
+            @jax.jit
+            def evaluate(vparams, x, y, num_samples, weight):
+                c_total = x.shape[0]
+                if c_total % block != 0:
+                    raise ValueError(
+                        f"clients ({c_total}) must be a multiple of "
+                        f"block_clients*dp={block}; pad the dataset with "
+                        f"ClientDataset.pad_for(plan, "
+                        f"block=config.block_clients)"
+                    )
+                nb = c_total // block
+
+                def blocked(a):
+                    return a.reshape((nb, block) + a.shape[1:])
+
+                def one(v, xc, yc, ns):
+                    v = jax.tree.map(
+                        lambda t: t.astype(jnp.float32)
+                        if jnp.issubdtype(t.dtype, jnp.floating) else t,
+                        v,
+                    )
+                    logits = apply_fn(v, xc)
+                    valid = (jnp.arange(xc.shape[0]) < ns)
+                    losses = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, yc
+                    )
+                    correct = (logits.argmax(-1) == yc)
+                    d = jnp.maximum(ns, 1).astype(jnp.float32)
+                    return (
+                        jnp.where(valid, losses, 0.0).sum() / d,
+                        jnp.where(valid, correct, False).sum() / d,
+                    )
+
+                def block_step(carry, inp):
+                    sum_loss, sum_acc, sum_w = carry
+                    bvp, bx, by, bns, bw = inp
+                    loss_c, acc_c = jax.vmap(one)(bvp, bx, by, bns)
+                    return (
+                        sum_loss + (bw * loss_c).sum(),
+                        sum_acc + (bw * acc_c).sum(),
+                        sum_w + bw.sum(),
+                    ), None
+
+                init = (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+                xs = (jax.tree.map(blocked, vparams), blocked(x), blocked(y),
+                      blocked(num_samples), blocked(weight))
+                (sum_loss, sum_acc, sum_w), _ = jax.lax.scan(
+                    block_step, init, xs
+                )
+                w = jnp.maximum(sum_w, 1e-8)
+                return sum_loss / w, sum_acc / w
+
+            return evaluate
+
+        return make
+
     def _build_evaluate_personal(self):
+        if self.plan.mp > 1:
+            return self._build_evaluate_personal_auto()
         cl = P("dp")
         rep = P()
         block = self.config.block_clients
@@ -1692,13 +2270,23 @@ def build_fedcore(
     config: FedCoreConfig = FedCoreConfig(),
     model_overrides: Optional[dict] = None,
     input_shape: Optional[Tuple[int, ...]] = None,
+    microbatches: Optional[int] = None,
 ) -> FedCore:
-    """Convenience constructor from the model registry."""
+    """Convenience constructor from the model registry.
+
+    ``microbatches`` — GPipe microbatch count for a pipeline-parallel
+    plan (``plan.pp > 1``; default pp). Rejected on non-pp plans."""
     from olearning_sim_tpu.models import get_model
 
     spec = get_model(model_name)
     model = spec.build(**(model_overrides or {}))
     in_shape = input_shape or spec.example_input_shape
+    if microbatches is not None and plan.pp <= 1:
+        raise ValueError(
+            "microbatches only applies to pipeline parallelism — build "
+            "the plan with make_mesh_plan(pp=...) (or the engine-params "
+            "{'parallel': {'pp': N}} block)"
+        )
 
     def apply_fn(params, x):
         return model.apply({"params": params}, x)
@@ -1747,12 +2335,29 @@ def build_fedcore(
         # Megatron-layout specs from the param shapes (transformer-block
         # tensors shard; everything else — and any model without such
         # blocks — stays replicated).
-        from olearning_sim_tpu.parallel.tp import tp_param_specs, warn_if_unsharded
+        from olearning_sim_tpu.parallel.tp import (
+            sharded_fraction,
+            tp_param_specs,
+            warn_if_unsharded,
+        )
 
         if shapes is None:  # aux detection failed before computing them
             shapes = jax.eval_shape(init_params_fn, jax.random.key(0))
         param_specs = tp_param_specs(shapes, plan.mp)
         warn_if_unsharded(shapes, param_specs, plan.mp, axis="mp")
+        # Published per model so dashboards (and the tp-coverage analyzer)
+        # can see how much of each family's parameter volume the mp axis
+        # actually distributes.
+        from olearning_sim_tpu.telemetry import instrument
+
+        instrument("ols_engine_tp_sharded_ratio").labels(
+            model=model_name
+        ).set(sharded_fraction(shapes, param_specs))
+
+    pp_train = None
+    if plan.pp > 1:
+        pp_train = (model, microbatches)
 
     return FedCore(apply_fn, init_params_fn, algorithm, plan, config,
-                   param_specs=param_specs, apply_aux_fn=apply_aux_fn)
+                   param_specs=param_specs, apply_aux_fn=apply_aux_fn,
+                   pp_train=pp_train)

@@ -158,8 +158,27 @@ def build_runner_from_taskconfig(
     from olearning_sim_tpu.engine.compile_cache import enable_compile_cache
 
     enable_compile_cache()
-    plan = plan if plan is not None else make_mesh_plan()
     params = _engine_params(tc)
+
+    # Model parallelism rides the engine params blob (docs/performance.md):
+    #   {"parallel": {"mp": 2}}                      # tensor parallel
+    #   {"parallel": {"pp": 2, "microbatches": 4}}   # stage pipelined
+    # The block selects the mesh shape, so it is resolved BEFORE the plan:
+    # with no injected plan the mesh is built to the block's mp/pp; an
+    # injected plan must realize the block (a task validated for mp=2 must
+    # never silently run replicated on a dp-only mesh).
+    from olearning_sim_tpu.parallel.mesh import ParallelConfig
+
+    parallel = (ParallelConfig.from_dict(params["parallel"])
+                if params.get("parallel") else ParallelConfig())
+    if plan is None:
+        plan = parallel.make_plan() if parallel.enabled else make_mesh_plan()
+    elif parallel.enabled and not parallel.matches(plan):
+        raise ValueError(
+            f"task {tc.taskID.taskID}: engine params ask for "
+            f"parallel mp={parallel.mp} pp={parallel.pp} but the supplied "
+            f"mesh plan has mp={plan.mp} pp={plan.pp}"
+        )
 
     model_cfg = params.get("model", {})
     algo_cfg = dict(params.get("algorithm", {}))
@@ -179,6 +198,7 @@ def build_runner_from_taskconfig(
         cfg,
         model_overrides=model_cfg.get("overrides"),
         input_shape=input_shape,
+        microbatches=parallel.microbatches,
     )
 
     from olearning_sim_tpu.models import get_model

@@ -74,6 +74,93 @@ class MeshPlan:
         return P()
 
 
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """The validated ``{"parallel": {...}}`` engine-params block: how many
+    ways the per-client train step is model-parallel.
+
+    - ``mp`` — tensor parallelism (Megatron layout over the mesh ``mp``
+      axis, :mod:`olearning_sim_tpu.parallel.tp`); the round program is
+      manual over ``dp`` and auto over ``mp``.
+    - ``pp`` — GPipe-style pipeline parallelism of block-structured
+      models (:mod:`olearning_sim_tpu.parallel.pipeline`); the per-client
+      train body streams microbatches through ``pp`` stages.
+    - ``microbatches`` — pipeline microbatch count M (default: ``pp``).
+
+    ``mp`` and ``pp`` are mutually exclusive in this engine (one model
+    axis per family; the composition matrix in docs/performance.md says
+    what rejects what). Parsed at submit validation
+    (``taskmgr/validation.py``) AND at build (``engine/task_bridge.py``)
+    so a typo'd knob fails before any compile.
+    """
+
+    mp: int = 1
+    pp: int = 1
+    microbatches: Optional[int] = None
+
+    def __post_init__(self):
+        for fld in ("mp", "pp"):
+            v = getattr(self, fld)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"parallel.{fld} must be an int >= 1, got {v!r}"
+                )
+        if self.mp > 1 and self.pp > 1:
+            raise ValueError(
+                "parallel.mp and parallel.pp are mutually exclusive: one "
+                "model axis per client family (tensor-parallel OR "
+                "stage-pipelined; see docs/performance.md)"
+            )
+        if self.microbatches is not None:
+            if not isinstance(self.microbatches, int) or self.microbatches < 1:
+                raise ValueError(
+                    f"parallel.microbatches must be an int >= 1, got "
+                    f"{self.microbatches!r}"
+                )
+            if self.pp <= 1:
+                raise ValueError(
+                    "parallel.microbatches only applies to pipeline "
+                    "parallelism (set parallel.pp > 1)"
+                )
+
+    @property
+    def enabled(self) -> bool:
+        return self.mp > 1 or self.pp > 1
+
+    @classmethod
+    def from_dict(cls, obj: dict) -> "ParallelConfig":
+        """``{"parallel": {"mp": 2}}`` or ``{"parallel": {"pp": 2,
+        "microbatches": 4}}``. Unknown keys are rejected so a typo
+        (``np``, ``micro_batches``) fails at submit time, not by silently
+        running the replicated program."""
+        if not isinstance(obj, dict):
+            raise TypeError(
+                f"parallel config must be a JSON object, got "
+                f"{type(obj).__name__}"
+            )
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(obj) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown parallel config keys: {unknown} "
+                f"(known: {sorted(known)})"
+            )
+        kw = {}
+        for k in ("mp", "pp", "microbatches"):
+            if obj.get(k) is not None:
+                kw[k] = int(obj[k])
+        return cls(**kw)
+
+    def make_plan(self, devices: Optional[Sequence["jax.Device"]] = None
+                  ) -> "MeshPlan":
+        """The mesh this block asks for (over ``devices``, default all)."""
+        return make_mesh_plan(devices=devices, mp=self.mp, pp=self.pp)
+
+    def matches(self, plan: "MeshPlan") -> bool:
+        """Whether an externally supplied plan realizes this block."""
+        return plan.mp == self.mp and plan.pp == self.pp
+
+
 def make_mesh_plan(
     devices: Optional[Sequence[jax.Device]] = None,
     dp: Optional[int] = None,

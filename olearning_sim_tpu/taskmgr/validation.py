@@ -188,6 +188,9 @@ def validate_correctness(request) -> Tuple[bool, str]:
                         from olearning_sim_tpu.engine.pacing import (
                             DeadlineConfig,
                         )
+                        from olearning_sim_tpu.parallel.mesh import (
+                            ParallelConfig,
+                        )
                         from olearning_sim_tpu.resilience.quarantine import (
                             parse_quarantine_params,
                         )
@@ -217,6 +220,7 @@ def validate_correctness(request) -> Tuple[bool, str]:
                             ("fedcore", FedCoreConfig.from_dict),
                             ("quarantine", parse_quarantine_params),
                             ("async", AsyncConfig.from_dict),
+                            ("parallel", ParallelConfig.from_dict),
                         ):
                             if not op_params.get(block):
                                 continue
@@ -276,6 +280,85 @@ def validate_correctness(request) -> Tuple[bool, str]:
                                     f"not support personalized / "
                                     f"control-variate algorithms",
                                 )
+                            if block == "parallel":
+                                # The composition matrix
+                                # (docs/performance.md): the engine
+                                # rejects these pairs at build time;
+                                # catch them at submit instead.
+                                if parsed.pp > 1:
+                                    _req(
+                                        not op_params.get("defense"),
+                                        f"operator {op.name} parallel "
+                                        f"params invalid: pipeline "
+                                        f"parallelism (pp>1) does not "
+                                        f"compose with the defense block "
+                                        f"(use mp for defended families)",
+                                    )
+                                    _req(
+                                        not op_params.get("deadline"),
+                                        f"operator {op.name} parallel "
+                                        f"params invalid: pipeline "
+                                        f"parallelism (pp>1) runs the "
+                                        f"plain program only — no "
+                                        f"deadline block",
+                                    )
+                                    _req(
+                                        not op_params.get("async"),
+                                        f"operator {op.name} parallel "
+                                        f"params invalid: pipeline "
+                                        f"parallelism (pp>1) does not "
+                                        f"compose with buffered async "
+                                        f"rounds",
+                                    )
+                                    name, personalized, control = \
+                                        _algo_traits(op_params)
+                                    _req(
+                                        not (personalized or control),
+                                        f"operator {op.name} parallel "
+                                        f"params invalid: pipeline "
+                                        f"parallelism (pp>1) does not "
+                                        f"support the personalized / "
+                                        f"control-variate algorithm "
+                                        f"{name!r}",
+                                    )
+                                    fed = op_params.get("fedcore") or {}
+                                    _req(
+                                        not fed.get("shard_server_update"),
+                                        f"operator {op.name} parallel "
+                                        f"params invalid: pp>1 does not "
+                                        f"compose with "
+                                        f"fedcore.shard_server_update "
+                                        f"(the flat dp coordinate shards "
+                                        f"would cut across the stage "
+                                        f"partition)",
+                                    )
+                                if parsed.mp > 1:
+                                    dfs = op_params.get("defense")
+                                    gathers = False
+                                    if dfs:
+                                        try:
+                                            gathers = DefenseConfig \
+                                                .from_dict(dfs) \
+                                                .gathers_deltas
+                                        except Exception:  # noqa: BLE001
+                                            gathers = False  # fails above
+                                    _req(
+                                        not gathers,
+                                        f"operator {op.name} parallel "
+                                        f"params invalid: robust "
+                                        f"aggregators / anomaly scoring "
+                                        f"do not compose with a "
+                                        f"model-parallel mesh (mp>1) — "
+                                        f"use clip_norm only (see "
+                                        f"docs/performance.md)",
+                                    )
+                                    _req(
+                                        not op_params.get("async"),
+                                        f"operator {op.name} parallel "
+                                        f"params invalid: buffered async "
+                                        f"rounds do not compose with a "
+                                        f"model-parallel mesh (mp>1)",
+                                    )
 
         units = list(request.logicalSimulation.computationUnit.devicesUnit)
         _req(len(units) == len(set(units)), "computationUnit.devicesUnit has repeats")

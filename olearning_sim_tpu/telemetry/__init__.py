@@ -180,6 +180,14 @@ CATALOG = {
         "compilation cache (first compile of a round-program variant)",
         (),
     ),
+    "ols_engine_tp_sharded_ratio": (
+        GAUGE,
+        "Fraction of parameter elements the mesh mp axis actually shards "
+        "for a tensor-parallel build, per model (parallel/tp "
+        "sharded_fraction; 0 means the model axis is pure replication — "
+        "the tp_coverage analyzer fails mp>1 configs below 50%)",
+        ("model",),
+    ),
     "ols_engine_collective_bytes": (
         GAUGE,
         "Output bytes of the round program's dominant cross-replica "

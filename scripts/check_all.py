@@ -16,6 +16,9 @@ event_kinds           resilience event vocabulary + docs
                       (scripts/check_event_kinds)
 injection_points      chaos points documented + tested
                       (scripts/check_injection_points)
+tp_coverage           every mp>1 task config shards >=50% of parameter
+                      elements (analysis/tp_coverage; pure eval_shape,
+                      no compile)
 hlo_collectives       defended program has no O(clients x params)
                       all-gather (scripts/check_hlo_collectives; shares
                       the grid compile below)
@@ -81,7 +84,12 @@ def build_registry(grid_artifacts=None):
     import check_injection_points
     import check_metrics
 
-    from olearning_sim_tpu.analysis import ast_rules, hlo_audit, retrace
+    from olearning_sim_tpu.analysis import (
+        ast_rules,
+        hlo_audit,
+        retrace,
+        tp_coverage,
+    )
 
     cache = {"arts": grid_artifacts}
 
@@ -111,6 +119,7 @@ def build_registry(grid_artifacts=None):
         "metrics": check_metrics.check,
         "event_kinds": check_event_kinds.check,
         "injection_points": check_injection_points.check,
+        "tp_coverage": tp_coverage.check,
         "hlo_collectives": hlo_collectives_check,
         "hlo_audit": lambda: hlo_audit.check(artifacts_by_name=arts()),
         "retrace": lambda: retrace.check(artifacts_by_name=arts()),

@@ -159,3 +159,37 @@ def test_budget_accounting(bench, monkeypatch):
     assert bench._remaining(0) < 0
     assert bench.DEGRADED_BUDGET_S >= 900
     assert bench.TOTAL_BUDGET_S >= bench.DEGRADED_BUDGET_S
+
+
+def test_suite_order_unbanked_first(bench):
+    """Starvation fix: families with no measured record run before
+    re-captures; relative order is stable within each group, and a
+    skipped/errored entry does NOT count as banked."""
+    fams = [{"name": "a"}, {"name": "b"}, {"name": "c"}, {"name": "d"}]
+    suite = [
+        {"family": "a", "rounds_per_sec": 1.0},
+        {"family": "b", "skipped": "budget"},           # not banked
+        {"family": "c", "error": "tunnel died"},        # not banked
+    ]
+    ordered = [f["name"] for f in bench._suite_order(fams, suite)]
+    assert ordered == ["b", "c", "d", "a"]
+
+
+def test_family_cost_estimate_reads_banked_record(bench):
+    suite = [
+        {"family": "heavy", "rounds_per_sec": 0.01, "compile_sec": 300.0,
+         "round_time_sec": 60.0, "timed_rounds": 2},
+        {"family": "skipped", "skipped": "budget"},
+    ]
+    est = bench._family_cost_estimate("heavy", suite)
+    # compile + (timed + warmup) rounds + 30s subprocess margin.
+    assert est == 300.0 + 60.0 * 3 + 30.0
+    assert bench._family_cost_estimate("skipped", suite) is None
+    assert bench._family_cost_estimate("never-run", suite) is None
+    # Cross-backend estimates do not transfer: a degraded-CPU cost must
+    # not skip a cheap TPU re-capture (nor a TPU cost green-light a CPU
+    # family into a timeout kill).
+    suite[0]["backend"] = "cpu"
+    assert bench._family_cost_estimate("heavy", suite, backend="tpu") is None
+    assert bench._family_cost_estimate("heavy", suite,
+                                       backend="cpu") == est

@@ -1,0 +1,315 @@
+"""Tensor-parallel (mp>1) round engine: mp=1 byte-identity, the
+per-client delta aggregation oracle, mp x shard_server_update layout and
+parity, mp-sharded checkpoint resume, variant composition, and the
+tp_coverage analyzer.
+
+The mp=1-unchanged guarantee has two layers: here, a build WITH
+all-replicated param_specs must lower byte-identically to a build
+without any (the ``_tp_active`` gate); repo-wide, the PR's
+analysis/budgets.json diff added the 9 mp entries WITHOUT touching any
+of the 28 pre-existing variants — the grid compile is the
+byte-level witness that the mp wiring left every mp=1 program alone.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from olearning_sim_tpu.engine import build_fedcore, fedadam, fedavg
+from olearning_sim_tpu.engine.client_data import (
+    make_synthetic_dataset,
+    make_synthetic_text_dataset,
+)
+from olearning_sim_tpu.engine.defense import DefenseConfig
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.engine.runner import (
+    DataPopulation,
+    OperatorSpec,
+    SimulationRunner,
+)
+from olearning_sim_tpu.parallel.mesh import global_put, make_mesh_plan
+
+TEXT_KW = dict(
+    model_overrides={
+        "vocab_size": 128, "max_len": 8, "width": 32, "depth": 2,
+        "heads": 4, "mlp_dim": 64, "num_classes": 2,
+    },
+    input_shape=(8,),
+)
+
+
+def make_core(mp, dp=None, algorithm=None, **cfg_kw):
+    plan = make_mesh_plan(dp=dp if dp is not None else 8 // mp, mp=mp)
+    cfg_kw.setdefault("batch_size", 4)
+    cfg_kw.setdefault("max_local_steps", 2)
+    cfg_kw.setdefault("block_clients", 2)
+    core = build_fedcore("distilbert", algorithm or fedavg(0.1), plan,
+                         FedCoreConfig(**cfg_kw), **TEXT_KW)
+    return plan, core
+
+
+def make_ds(plan, block=2, num_clients=16, seed=5):
+    return make_synthetic_text_dataset(
+        seed=seed, num_clients=num_clients, n_local=6, seq_len=8,
+        num_classes=2, vocab_size=128,
+    ).pad_for(plan, block).place(plan)
+
+
+# ------------------------------------------------------ mp=1 byte-identity
+def test_mp1_program_byte_identical_with_replicated_specs():
+    """The _tp_active gate: at mp=1 (and with specs that shard nothing)
+    the manual round program must lower byte-identically to a build that
+    never heard of param_specs."""
+    from olearning_sim_tpu.engine.fedcore import FedCore
+
+    plan = make_mesh_plan(dp=4, mp=1)
+    cfg = FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2)
+    base = build_fedcore("mlp2", fedavg(0.1), plan, cfg,
+                         model_overrides={"hidden": [8], "num_classes": 3},
+                         input_shape=(8,))
+    assert base.param_specs is None  # mp=1 infers no specs
+    shapes = jax.eval_shape(base.init_params_fn, jax.random.key(0))
+    specced = FedCore(
+        base.apply_fn, base.init_params_fn, fedavg(0.1), plan, cfg,
+        param_specs=jax.tree.map(lambda _: P(), shapes),
+    )
+    assert not specced._tp_active
+    ds = make_synthetic_dataset(0, 16, 6, (8,), 3).pad_for(plan, 2).place(plan)
+    s1 = base.init_state(jax.random.key(1))
+    s2 = specced.init_state(jax.random.key(1))
+    low1 = base.lower_round_step(s1, ds).as_text()
+    low2 = specced.lower_round_step(s2, ds).as_text()
+    assert low1 == low2
+
+
+# ----------------------------------------------- delta aggregation oracle
+def test_mp2_delta_aggregation_matches_numpy_oracle():
+    """One fedavg round at mp=2 (server sgd lr=1: new = old + mean_delta)
+    against a numpy-aggregated oracle built from per-client deltas the
+    SAME program produces under one-hot weights — proves the tp-sharded
+    weighted-sum/normalize path does exactly sum(w_c * delta_c) / sum(w)
+    with no leakage across the mp shards."""
+    plan, core = make_core(mp=2, batch_size=4, max_local_steps=1,
+                           block_clients=1)
+    ds = make_ds(plan, block=1, num_clients=4)
+    C = ds.num_clients
+    weights = np.asarray(ds.weight, np.float32)
+
+    def round_delta(w):
+        state = core.init_state(jax.random.key(3))
+        p0 = jax.tree.map(lambda a: np.asarray(a, np.float32), state.params)
+        ds_w = dataclasses.replace(ds, weight=global_put(
+            np.asarray(w, np.float32), plan.client_sharding()))
+        state, _ = core.round_step(state, ds_w)
+        return jax.tree.map(
+            lambda a, b: np.asarray(a, np.float32) - b, state.params, p0
+        )
+
+    per_client = [round_delta(np.eye(C, dtype=np.float32)[c])
+                  for c in range(C)]
+    combined = round_delta(weights)
+
+    flat_pc = [jax.tree.leaves(d) for d in per_client]
+    for i, leaf in enumerate(jax.tree.leaves(combined)):
+        oracle = sum(weights[c] * flat_pc[c][i] for c in range(C))
+        oracle /= weights.sum()
+        np.testing.assert_allclose(leaf, oracle, atol=1e-5, rtol=1e-4)
+
+
+# --------------------------------------- mp x shard_server_update layout
+def test_mp2_sharded_update_layout_and_parity():
+    """The lifted fedcore restriction: shard_server_update composes with
+    mp=2 — per-coordinate optimizer state is flat-padded per (dp, mp)
+    shard (O(params/(dp*mp)) resident per chip) and the trajectory
+    matches the mp=1 sharded run within allclose."""
+    plan2, core2 = make_core(mp=2, algorithm=fedadam(0.1),
+                             shard_server_update=True)
+    ds2 = make_ds(plan2)
+    s2 = core2.init_state(jax.random.key(3))
+
+    n_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(s2.params)
+    )
+    n_dev = plan2.dp * plan2.mp
+    for leaf, sharded in zip(jax.tree.leaves(s2.opt_state),
+                             jax.tree.leaves(core2._opt_sharded)):
+        if not sharded:
+            continue
+        local = leaf.addressable_shards[0].data
+        assert local.ndim == 1
+        # Flat padded coordinates split over EVERY device: dp x mp.
+        assert local.shape[0] * n_dev == leaf.shape[0]
+        assert local.shape[0] <= (n_params // n_dev) + n_dev
+    assert any(jax.tree.leaves(core2._opt_sharded))
+
+    plan1, core1 = make_core(mp=1, algorithm=fedadam(0.1),
+                             shard_server_update=True)
+    ds1 = make_ds(plan1)
+    s1 = core1.init_state(jax.random.key(3))
+    for _ in range(2):
+        s1, m1 = core1.round_step(s1, ds1)
+        s2, m2 = core2.round_step(s2, ds2)
+    np.testing.assert_allclose(float(m1.mean_loss), float(m2.mean_loss),
+                               rtol=2e-2)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-2, rtol=5e-2)
+
+
+# ------------------------------------------------- checkpoint + resume
+def _make_runner(core, ds, task_id, rounds, checkpointer=None):
+    pop = DataPopulation(
+        name="data_0", dataset=ds, device_classes=["c"],
+        class_of_client=np.zeros(ds.num_clients, int),
+        nums=[ds.num_real_clients], dynamic_nums=[0],
+    )
+    return SimulationRunner(
+        task_id=task_id, core=core, populations=[pop],
+        operators=[OperatorSpec(name="train")], rounds=rounds,
+        checkpointer=checkpointer,
+    )
+
+
+def test_mp_sharded_opt_state_resumes_bitwise(tmp_path):
+    """PR 4 crash-harness property at mp=2 + shard_server_update: a
+    fresh-runner resume over the manifest-committed checkpoint finishes
+    bitwise identical — params AND the (dp, mp)-flat-sharded optimizer
+    state — to an uninterrupted run."""
+    from olearning_sim_tpu.checkpoint import RoundCheckpointer
+
+    ROUNDS = 4
+    plan, core = make_core(mp=2, algorithm=fedadam(0.1),
+                           shard_server_update=True,
+                           max_local_steps=1)
+    ds = make_ds(plan)
+
+    r_full = _make_runner(core, ds, "mp-ck", ROUNDS)
+    r_full.run()
+
+    ck_a = RoundCheckpointer(str(tmp_path / "ck"), max_to_keep=4)
+    _make_runner(core, ds, "mp-ck", 2, checkpointer=ck_a).run()
+    ck_a.wait()
+    assert os.path.isfile(str(tmp_path / "ck" / "manifests" / "step-1.json"))
+    ck_b = RoundCheckpointer(str(tmp_path / "ck"), max_to_keep=4)
+    r_res = _make_runner(core, ds, "mp-ck", ROUNDS, checkpointer=ck_b)
+    history = r_res.run()
+    assert [h["round"] for h in history] == list(range(ROUNDS))
+
+    for a, b in zip(jax.tree.leaves(jax.device_get(
+                        r_full.states["data_0"].params)),
+                    jax.tree.leaves(jax.device_get(
+                        r_res.states["data_0"].params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(jax.device_get(
+                        r_full.states["data_0"].opt_state)),
+                    jax.tree.leaves(jax.device_get(
+                        r_res.states["data_0"].opt_state))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------- composition
+def test_mp2_deadline_attack_clip_compose():
+    """The mp-supported variant set in one round: deadline masking,
+    per-client attack scales, and streaming clip are data inputs of the
+    GSPMD-auto program too."""
+    plan, core = make_core(mp=2)
+    ds = make_ds(plan)
+    state = core.init_state(jax.random.key(0))
+    comp = np.zeros(ds.num_clients, np.float32)
+    comp[:4] = 9.0  # four stragglers past the deadline
+    scale = np.ones(ds.num_clients, np.float32)
+    scale[4:6] = -1.0
+    state, m = core.round_step(
+        state, ds,
+        completion_time=global_put(comp, plan.client_sharding()),
+        deadline=1.0,
+        attack_scale=global_put(scale, plan.client_sharding()),
+        defense=DefenseConfig(clip_norm=0.5, aggregator="mean"),
+    )
+    assert np.isfinite(float(m.mean_loss))
+    assert float(m.stragglers) == 4.0
+    assert float(m.clipped) >= 1.0
+
+
+def test_mp2_rejects_gathering_defense():
+    plan, core = make_core(mp=2)
+    ds = make_ds(plan)
+    state = core.init_state(jax.random.key(0))
+    with pytest.raises(ValueError, match="model-parallel"):
+        core.round_step(
+            state, ds,
+            defense=DefenseConfig(clip_norm=5.0, aggregator="trimmed_mean",
+                                  trim_fraction=0.1),
+        )
+
+
+def test_mp2_sharded_update_knobs_never_retrace():
+    """mp-dim retrace probe (the analyzer covers lowering equality on the
+    grid; this pins the executable cache on the live core): changing
+    deadline and clip values across rounds at mp=2 keeps trace_count at
+    1 for the variant."""
+    plan, core = make_core(mp=2)
+    ds = make_ds(plan)
+    state = core.init_state(jax.random.key(0))
+    comp = global_put(np.linspace(0.1, 2.0, ds.num_clients, dtype=np.float32),
+                      plan.client_sharding())
+    for deadline, clip in ((1.5, 5.0), (0.5, 1.0e9)):
+        state, _ = core.round_step(
+            state, ds, completion_time=comp, deadline=deadline,
+            defense=DefenseConfig(clip_norm=clip, aggregator="mean"),
+        )
+    key = (True, False, ("mean", False))
+    assert core.trace_counts.get(key) == 1
+
+
+# ------------------------------------------------- tp_coverage analyzer
+def _write_config(dirpath, name, model_name, overrides, parallel,
+                  input_shape):
+    """A minimal task-config JSON shell the analyzer can parse."""
+    params = {
+        "model": {"name": model_name, "overrides": overrides,
+                  "input_shape": list(input_shape)},
+        "parallel": parallel,
+    }
+    cfg = {
+        "operatorflow": {
+            "operators": [
+                {"logical_simulation": {"operator_params": json.dumps(params)}}
+            ]
+        }
+    }
+    path = os.path.join(dirpath, name)
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    return path
+
+
+def test_tp_coverage_clean_on_repo_configs():
+    from olearning_sim_tpu.analysis import tp_coverage
+
+    assert tp_coverage.check() == []
+
+
+def test_tp_coverage_bites_on_unshardable_mp_config(tmp_path):
+    """A planted cnn mp=2 config (0% shardable) fails with a pointer to
+    the replicated leaves; a distilbert mp=2 config passes; an mp=1 or
+    parallel-free config is ignored."""
+    from olearning_sim_tpu.analysis import tp_coverage
+
+    _write_config(tmp_path, "bad_cnn_mp.json", "cnn4",
+                  {"features": [8, 8, 16]}, {"mp": 2}, (32, 32, 3))
+    _write_config(tmp_path, "good_bert_mp.json", "distilbert",
+                  TEXT_KW["model_overrides"], {"mp": 2}, (8,))
+    _write_config(tmp_path, "no_parallel.json", "cnn4",
+                  {"features": [8, 8, 16]}, None, (32, 32, 3))
+    problems = tp_coverage.check(configs_dir=str(tmp_path))
+    assert len(problems) == 1
+    assert "bad_cnn_mp.json" in problems[0]
+    assert "0.0%" in problems[0]
+    assert "Conv" in problems[0] or "unmatched leaves" in problems[0]

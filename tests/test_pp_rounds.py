@@ -1,0 +1,307 @@
+"""Pipeline-parallel round program (engine/pp_rounds.py): parity with the
+dense dp-only program, dp-invariance, composition rejections, and the
+engine-params wiring that selects it.
+
+The dp-invariance test pins the jaxlib-0.4.x miscompile this PR worked
+around: a manual shard_map whose operands were produced by surrounding
+GSPMD-auto code (the in-jit block stack) silently read corrupted values
+once dp > 1 — per-client losses depended on the mesh's dp extent. The
+stack/slice now runs inside the manual region (pp_rounds module
+docstring) and per-client losses must be bitwise dp-invariant.
+"""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from olearning_sim_tpu.engine import build_fedcore, fedavg, fedprox
+from olearning_sim_tpu.engine.client_data import make_synthetic_text_dataset
+from olearning_sim_tpu.engine.fedcore import FedCoreConfig
+from olearning_sim_tpu.parallel.mesh import ParallelConfig, make_mesh_plan
+
+MODEL_KW = dict(
+    model_overrides={
+        "vocab_size": 128, "max_len": 8, "width": 32, "depth": 2,
+        "heads": 4, "mlp_dim": 64, "num_classes": 2,
+    },
+    input_shape=(8,),
+)
+
+
+def make_core(dp, pp, algorithm=None, microbatches=2, **cfg_kw):
+    plan = make_mesh_plan(dp=dp, mp=1, pp=pp)
+    cfg_kw.setdefault("batch_size", 4)
+    cfg_kw.setdefault("max_local_steps", 2)
+    cfg_kw.setdefault("block_clients", 2)
+    cfg = FedCoreConfig(**cfg_kw)
+    core = build_fedcore(
+        "distilbert", algorithm or fedavg(0.1), plan, cfg,
+        microbatches=microbatches if pp > 1 else None, **MODEL_KW,
+    )
+    return plan, core
+
+
+def make_ds(plan, block=2, num_clients=16):
+    return make_synthetic_text_dataset(
+        seed=5, num_clients=num_clients, n_local=6, seq_len=8,
+        num_classes=2, vocab_size=128,
+    ).pad_for(plan, block).place(plan)
+
+
+def _run_rounds(core, ds, rounds=2):
+    state = core.init_state(jax.random.key(3))
+    p0 = jax.tree.map(np.asarray, state.params)
+    metrics = None
+    for _ in range(rounds):
+        state, metrics = core.round_step(state, ds)
+    delta = jax.tree.map(
+        lambda a, b: np.asarray(a, np.float32) - np.asarray(b, np.float32),
+        state.params, p0,
+    )
+    return delta, metrics
+
+
+def test_pp2_matches_dense():
+    """Two pipelined rounds track the dense dp-only program: the GPipe
+    schedule only changes WHERE the per-client compute runs (same RNG
+    streams, same minibatch draws; bf16 activations bound the drift)."""
+    plan_d, core_d = make_core(dp=8, pp=1)
+    d_dense, m_dense = _run_rounds(core_d, make_ds(plan_d))
+    plan_p, core_p = make_core(dp=4, pp=2)
+    d_pp, m_pp = _run_rounds(core_p, make_ds(plan_p))
+
+    np.testing.assert_allclose(
+        float(m_dense.mean_loss), float(m_pp.mean_loss), rtol=2e-2
+    )
+    assert float(m_dense.weight_sum) == float(m_pp.weight_sum)
+    assert float(m_dense.clients_trained) == float(m_pp.clients_trained)
+    for a, b in zip(jax.tree.leaves(d_dense), jax.tree.leaves(d_pp)):
+        scale = max(float(np.max(np.abs(a))), 1e-3)
+        assert float(np.max(np.abs(a - b))) < 0.05 * scale + 5e-3
+
+
+def test_pp_client_losses_dp_invariant():
+    """REGRESSION (the auto->manual operand miscompile): per-client
+    losses from the real compiled pp program must be BITWISE identical
+    across dp extents — each client's training is dp-independent math."""
+    losses = {}
+    for dp in (1, 4):
+        plan, core = make_core(dp=dp, pp=2)
+        ds = make_ds(plan)
+        state = core.init_state(jax.random.key(3))
+        _, m = core.round_step(state, ds)
+        uid = np.asarray(ds.client_uid)
+        by_uid = dict(zip(uid.tolist(), np.asarray(m.client_loss).tolist()))
+        losses[dp] = by_uid
+    assert losses[1] == losses[4]
+
+
+def test_pp_fedprox_matches_dense_and_second_round_no_retrace():
+    """REGRESSION (prox scale): the FedProx penalty's block-slice term is
+    psum'd over pp so its gradient rides the same psum-transpose path as
+    the CE grads — a stage-local penalty came out mu/pp on every
+    transformer block after grad_fix's uniform /pp, silently weakening
+    the proximal pull. A large mu makes the pull dominate the update, so
+    dense-parity of the round deltas pins the scale."""
+    # mu=10 x 4 steps makes the prox pull DOMINATE the update: with the
+    # stage-local penalty this measures loss 8.15-vs-10.10 and >5x delta
+    # mismatch (mutation-tested); the psum'd penalty lands within ~5%.
+    mu = 10.0
+    plan_d, core_d = make_core(dp=8, pp=1, algorithm=fedprox(0.1, mu=mu),
+                               max_local_steps=4)
+    d_dense, m_dense = _run_rounds(core_d, make_ds(plan_d))
+    plan_p, core_p = make_core(dp=4, pp=2, algorithm=fedprox(0.1, mu=mu),
+                               max_local_steps=4)
+    d_pp, m_pp = _run_rounds(core_p, make_ds(plan_p))
+
+    np.testing.assert_allclose(
+        float(m_dense.mean_loss), float(m_pp.mean_loss), rtol=2e-2
+    )
+    for a, b in zip(jax.tree.leaves(d_dense), jax.tree.leaves(d_pp)):
+        scale = max(float(np.max(np.abs(a))), 1e-3)
+        assert float(np.max(np.abs(a - b))) < 0.12 * scale + 5e-3
+    # One trace total for the pp variant across both rounds.
+    (count,) = [v for k, v in core_p.trace_counts.items() if k[0] == "pp"]
+    assert count == 1
+
+
+def test_pp_microbatches_must_divide_batch():
+    with pytest.raises(ValueError, match="microbatches"):
+        make_core(dp=4, pp=2, microbatches=3, batch_size=4)
+
+
+def test_pp_must_divide_depth():
+    plan = make_mesh_plan(dp=2, mp=1, pp=4)  # depth 2 % pp 4 != 0
+    with pytest.raises(ValueError, match="divide the model depth"):
+        build_fedcore("distilbert", fedavg(0.1), plan,
+                      FedCoreConfig(batch_size=4, max_local_steps=1,
+                                    block_clients=2), **MODEL_KW)
+
+
+def test_pp_rejects_shard_server_update():
+    with pytest.raises(ValueError, match="shard_server_update"):
+        make_core(dp=4, pp=2, shard_server_update=True)
+
+
+def test_pp_rejects_deadline_attack_defense_at_launch():
+    plan, core = make_core(dp=4, pp=2)
+    ds = make_ds(plan)
+    state = core.init_state(jax.random.key(0))
+    comp = jnp.ones((ds.num_clients,), jnp.float32)
+    with pytest.raises(ValueError, match="plain program only"):
+        core.round_step(state, ds, completion_time=comp, deadline=0.5)
+    with pytest.raises(ValueError, match="plain program only"):
+        core.round_step(state, ds, attack_scale=comp)
+
+
+def test_pp_rejects_non_block_model():
+    plan = make_mesh_plan(dp=4, mp=1, pp=2)
+    with pytest.raises(ValueError, match="block-structured"):
+        build_fedcore("mlp2", fedavg(0.1), plan,
+                      FedCoreConfig(batch_size=4, max_local_steps=1,
+                                    block_clients=2),
+                      model_overrides={"hidden": [16], "num_classes": 3},
+                      input_shape=(8,))
+
+
+# ------------------------------------------------------ ParallelConfig
+def test_parallel_config_validation():
+    assert not ParallelConfig().enabled
+    assert ParallelConfig(mp=2).enabled
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ParallelConfig(mp=2, pp=2)
+    with pytest.raises(ValueError, match="microbatches"):
+        ParallelConfig(mp=2, microbatches=4)  # microbatches need pp
+    with pytest.raises(ValueError, match="unknown parallel config"):
+        ParallelConfig.from_dict({"np": 2})
+    with pytest.raises(ValueError, match="must be an int"):
+        ParallelConfig(mp=0)
+    pc = ParallelConfig.from_dict({"pp": 2, "microbatches": 4})
+    assert (pc.pp, pc.microbatches) == (2, 4)
+    plan = pc.make_plan()
+    assert plan.pp == 2 and pc.matches(plan)
+    assert not ParallelConfig(mp=2).matches(plan)
+
+
+# ------------------------------------------------- engine-params bridge
+def _pp_task_config(parallel=None, fedcore_extra=None):
+    """A tiny distilbert task JSON with an optional parallel block."""
+    import copy
+    import os
+
+    cfg_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "configs", "fedadam_sent140_distilbert.json",
+    )
+    with open(cfg_path) as f:
+        base = json.load(f)
+    base = copy.deepcopy(base)
+    base["operatorflow"]["flow_setting"]["round"] = 1
+    for td in base["target"]["data"]:
+        k = len(td["total_simulation"]["nums"])
+        td["total_simulation"]["nums"] = [4] * k
+        td["total_simulation"]["dynamic_nums"] = [1] * k
+        td["allocation"]["logical_simulation"] = [4] * k
+        td["allocation"]["device_simulation"] = [0] * k
+    for rr in base["logical_simulation"]["resource_request"]:
+        rr["num_request"] = [1] * len(rr["num_request"])
+    op_info = base["operatorflow"]["operators"][0]["logical_simulation"]
+    params = json.loads(op_info["operator_params"])
+    params["model"]["overrides"].update(MODEL_KW["model_overrides"])
+    params["model"]["input_shape"] = [8]
+    params["fedcore"].update({"batch_size": 4, "max_local_steps": 1,
+                              "block_clients": 1})
+    if fedcore_extra:
+        params["fedcore"].update(fedcore_extra)
+    params["data"]["synthetic"].update({"n_local": 4, "vocab_size": 128})
+    params["data"]["eval_n"] = 32
+    if parallel is not None:
+        params["parallel"] = parallel
+    op_info["operator_params"] = json.dumps(params)
+    return base
+
+
+def test_parallel_block_reaches_mesh_plan_via_bridge():
+    from olearning_sim_tpu.engine.task_bridge import (
+        build_runner_from_taskconfig,
+    )
+
+    runner = build_runner_from_taskconfig(json.dumps(
+        _pp_task_config(parallel={"pp": 2, "microbatches": 2})
+    ))
+    assert runner.core.plan.pp == 2
+    history = runner.run()
+    assert len(history) == 1
+
+    runner = build_runner_from_taskconfig(json.dumps(
+        _pp_task_config(parallel={"mp": 2})
+    ))
+    assert runner.core.plan.mp == 2
+    assert runner.core.param_specs is not None
+
+
+def test_parallel_block_conflicts_with_injected_plan():
+    from olearning_sim_tpu.engine.task_bridge import (
+        build_runner_from_taskconfig,
+    )
+
+    with pytest.raises(ValueError, match="mesh plan has mp=1 pp=1"):
+        build_runner_from_taskconfig(
+            json.dumps(_pp_task_config(parallel={"pp": 2})),
+            plan=make_mesh_plan(),
+        )
+
+
+def test_parallel_block_validated_at_submit():
+    from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+    from olearning_sim_tpu.taskmgr.validation import validate_task_parameters
+
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(
+        _pp_task_config(parallel={"pp": 2, "microbatches": 2})
+    )))
+    assert ok, msg
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(
+        _pp_task_config(parallel={"np": 2})
+    )))
+    assert not ok and "parallel" in msg
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(
+        _pp_task_config(parallel={"mp": 2, "pp": 2})
+    )))
+    assert not ok and "mutually exclusive" in msg
+    # Composition matrix at submit: pp x shard_server_update rejected.
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(
+        _pp_task_config(parallel={"pp": 2},
+                        fedcore_extra={"shard_server_update": True})
+    )))
+    assert not ok and "shard_server_update" in msg
+    # pp x deadline rejected at submit (the engine runs the plain program
+    # only; the runner would otherwise die at first round launch).
+    cfg = _pp_task_config(parallel={"pp": 2, "microbatches": 2})
+    op_info = cfg["operatorflow"]["operators"][0]["logical_simulation"]
+    params = json.loads(op_info["operator_params"])
+    params["deadline"] = {"deadline_s": 1.0}
+    op_info["operator_params"] = json.dumps(params)
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(cfg)))
+    assert not ok and "deadline" in msg
+    # mp x gathering defense rejected at submit (the engine would raise
+    # at launch — the matrix must bite before any compile).
+    cfg = _pp_task_config(parallel={"mp": 2})
+    op_info = cfg["operatorflow"]["operators"][0]["logical_simulation"]
+    params = json.loads(op_info["operator_params"])
+    params["defense"] = {"clip_norm": 5.0, "aggregator": "trimmed_mean",
+                         "trim_fraction": 0.1}
+    op_info["operator_params"] = json.dumps(params)
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(cfg)))
+    assert not ok and "model-parallel" in msg
+    # mp x async rejected at submit.
+    cfg = _pp_task_config(parallel={"mp": 2})
+    op_info = cfg["operatorflow"]["operators"][0]["logical_simulation"]
+    params = json.loads(op_info["operator_params"])
+    params["async"] = {"buffer_size": 4}
+    op_info["operator_params"] = json.dumps(params)
+    ok, msg = validate_task_parameters(json2taskconfig(json.dumps(cfg)))
+    assert not ok and "async" in msg

@@ -271,21 +271,32 @@ def test_sharded_update_composes_with_robust_aggregation(plan8, ds8):
                                    rtol=2e-5, atol=1e-6)
 
 
-def test_shard_server_update_rejects_tensor_parallel(plan8, adam_cores):
+def test_shard_server_update_accepts_inert_param_specs(plan8, adam_cores,
+                                                       ds8):
+    """The mp x shard_server_update rejection is LIFTED (ISSUE 9): specs
+    that shard nothing (mp=1 / all-replicated) leave the sharded-update
+    build byte-identical to a spec-free one via the ``_tp_active`` gate.
+    The really-sharded (dp x mp) composition is covered by
+    tests/test_modelparallel.py."""
     from olearning_sim_tpu.engine.fedcore import FedCore
 
     plan = plan8
-    core = adam_cores[0]  # donor of init/apply fns
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        FedCore(
-            core.apply_fn, core.init_params_fn, fedavg(0.1), plan,
-            FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2,
-                          shard_server_update=True),
-            param_specs=jax.tree.map(
-                lambda _: P(), jax.eval_shape(core.init_params_fn,
-                                              jax.random.key(0))
-            ),
-        )
+    core = adam_cores[1]  # spec-free shard_server_update donor
+    specced = FedCore(
+        core.apply_fn, core.init_params_fn, fedadam(0.1), plan,
+        FedCoreConfig(batch_size=4, max_local_steps=2, block_clients=2,
+                      shard_server_update=True),
+        param_specs=jax.tree.map(
+            lambda _: P(), jax.eval_shape(core.init_params_fn,
+                                          jax.random.key(0))
+        ),
+    )
+    assert not specced._tp_active
+    s1 = core.init_state(jax.random.key(1))
+    s2 = specced.init_state(jax.random.key(1))
+    low1 = core.lower_round_step(s1, ds8).as_text()
+    low2 = specced.lower_round_step(s2, ds8).as_text()
+    assert low1 == low2
 
 
 # --------------------------------------------------- checkpoint + resume
