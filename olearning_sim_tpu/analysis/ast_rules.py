@@ -94,7 +94,16 @@ WAIVERS: Dict[str, Dict[str, str]] = {
             "forever; the scan retries on a later pass",
     },
     "sqlite-connect": {},
-    "host-sync": {},
+    "host-sync": {
+        "olearning_sim_tpu/engine/fedcore.py":
+            "stream_round's per-client loss assembly is the streamed "
+            "round's designed host sync point: it runs AFTER the final "
+            "block and the finalize commit are dispatched, gathering the "
+            "per-block device losses into the host [C] array the caller "
+            "would otherwise device_get itself — the streamed analogue "
+            "of the runner's host_transfer phase, placed here because "
+            "the losses are per-block arrays private to the stream walk",
+    },
 }
 
 

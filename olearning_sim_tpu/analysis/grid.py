@@ -51,6 +51,14 @@ MP_MODELS = ("mlp2", "cnn4", "distilbert")
 # scan) is exercised with real multi-window data.
 ASYNC_BUFFER = 4
 
+# Global rows per stream block for the streamed ("stream") variants:
+# 16 clients / 8 rows -> 2 stream blocks, so the audited partial program
+# is the real multi-block shape (carry in, carry out). Streamed rounds
+# run the replicated server update on dp-only meshes, so the stream
+# sub-grid spans dp only (no shard_server_update axis — the composition
+# matrix in docs/performance.md).
+STREAM_ROWS = 8
+
 NUM_CLIENTS = 16
 INPUT_SHAPE = (8,)
 NUM_CLASSES = 3
@@ -115,7 +123,17 @@ def variant_grid(dps: Tuple[int, ...] = (1, 2),
         for p in programs
         for s in (False, True)
         for dp in dps
-    ] + (mp_variant_grid() if include_mp else [])
+    ] + (mp_variant_grid() + stream_variant_grid() if include_mp else [])
+
+
+def stream_variant_grid(dps: Tuple[int, ...] = (1, 2)) -> List[Variant]:
+    """The streamed sub-grid: the block-streamed PARTIAL program
+    (``FedCore.stream_round``'s per-block step, the one executed
+    population/stream_rows times per round) audited under the same
+    budget/retrace discipline. One program per dp — streaming has no
+    shard_server_update axis (replicated update only)."""
+    return [Variant(program="stream", shard_server_update=False, dp=dp)
+            for dp in dps]
 
 
 def mp_variant_grid(mp: int = 2, dp: int = 2) -> List[Variant]:
@@ -270,10 +288,88 @@ def _knob_kwargs(program: str, core, ds, setting: str) -> Dict:
     return kwargs
 
 
+def _stream_artifacts(variant: Variant) -> Dict:
+    """Artifacts for one streamed variant: the block-streamed PARTIAL
+    program AOT-lowered twice with different per-round DATA (masks, step
+    counts) — identical lowerings + one trace prove stream/scenario knobs
+    never retrace, and the compiled text feeds the same budget audit."""
+    import jax
+    import numpy as np
+
+    from olearning_sim_tpu.engine.client_data import (
+        HostClientStore,
+        make_synthetic_dataset,
+    )
+
+    core, state, _ = _core_state_ds(False, variant.dp, 1, MODEL)
+    host = make_synthetic_dataset(
+        0, NUM_CLIENTS, 6, INPUT_SHAPE, NUM_CLASSES
+    ).pad_for(core.plan, core.config.block_clients)
+    store = HostClientStore.from_dataset(host)
+
+    def knobs(setting):
+        b = setting == "b"
+        rng = np.random.default_rng(2 if b else 1)
+        return dict(
+            participate=(rng.random(host.num_clients)
+                         < (0.4 if b else 0.7)).astype(np.float32),
+            num_steps=rng.integers(
+                1, 3, host.num_clients
+            ).astype(np.int32),
+        )
+
+    lowered = core.lower_stream_step(state, store, STREAM_ROWS,
+                                     **knobs("a"))
+    n_variants = len(core._stream_variants)
+    lowered_b = core.lower_stream_step(state, store, STREAM_ROWS,
+                                       **knobs("b"))
+    same_fn = len(core._stream_variants) == n_variants
+    rpd = STREAM_ROWS // variant.dp
+    trace_count = core.trace_counts.get(
+        ("stream", rpd, False, False, None), 0
+    )
+
+    compiled = lowered.compile()
+    try:
+        mem = compiled.memory_analysis()
+        memory = {
+            "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+            "argument_bytes": int(getattr(mem, "argument_size_in_bytes", 0)),
+            "output_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+            "alias_bytes": int(getattr(mem, "alias_size_in_bytes", 0)),
+        }
+    except Exception:  # noqa: BLE001 — memory stats are best-effort
+        memory = None
+    params_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(state.params)
+    )
+    return {
+        "variant": variant.name,
+        "program": variant.program,
+        "dp": variant.dp,
+        "mp": variant.mp,
+        "model": variant.model,
+        "shard_server_update": variant.shard_server_update,
+        "lowered_a": lowered.as_text(),
+        "lowered_b": lowered_b.as_text(),
+        "same_fn": same_fn,
+        "trace_count": trace_count,
+        "compiled": compiled.as_text(),
+        "memory": memory,
+        "params_bytes": params_bytes,
+        "clients": host.num_clients,
+    }
+
+
 def artifacts(variant: Variant) -> Dict:
     """Lowered/compiled artifacts for one variant (process-cached)."""
     if variant.name in _ARTIFACTS:
         return _ARTIFACTS[variant.name]
+    if variant.program == "stream":
+        art = _stream_artifacts(variant)
+        _ARTIFACTS[variant.name] = art
+        return art
     import jax
 
     core, state, ds = _core_state_ds(variant.shard_server_update, variant.dp,

@@ -6,7 +6,11 @@ from olearning_sim_tpu.deviceflow.strategy import (
     is_real_time_dispatch,
 )
 from olearning_sim_tpu.deviceflow.validate import check_notify_start_params, check_strategy
-from olearning_sim_tpu.deviceflow.trace_compiler import ClientTrace, compile_trace
+from olearning_sim_tpu.deviceflow.trace_compiler import (
+    ClientTrace,
+    combine_traces,
+    compile_trace,
+)
 from olearning_sim_tpu.deviceflow.dispatcher import Clock, Dispatcher, VirtualClock
 from olearning_sim_tpu.deviceflow.flow import FlowManager
 from olearning_sim_tpu.deviceflow.registry import TaskRegistry
@@ -32,6 +36,7 @@ __all__ = [
     "analyze_real_time_strategy",
     "check_notify_start_params",
     "check_strategy",
+    "combine_traces",
     "compile_trace",
     "is_real_time_dispatch",
 ]

@@ -60,6 +60,35 @@ class ClientTrace:
         return float(released.max()) if released.size else 0.0
 
 
+def combine_traces(a: ClientTrace, b: ClientTrace) -> ClientTrace:
+    """Intersection of two behavior traces over the same population.
+
+    Used when a dispatch-strategy trace (network release schedule) and a
+    scenario availability trace (``engine/scenario.py`` — diurnal /
+    charging / churn masks) both apply to one round: a client
+    participates only if BOTH release it, its update arrives at the
+    LATER of the two times (it must be both dispatched and available),
+    and it counts as dropped if either side dropped it. Combining with
+    an all-on trace (``_all_on``) is an exact identity.
+    """
+    if a.participate.shape != b.participate.shape:
+        raise ValueError(
+            f"cannot combine traces over different populations: "
+            f"{a.participate.shape[0]} vs {b.participate.shape[0]} clients"
+        )
+    participate = a.participate * b.participate
+    arrival = np.where(
+        participate > 0,
+        np.maximum(a.arrival_time, b.arrival_time),
+        np.float32(np.inf),
+    ).astype(np.float32)
+    return ClientTrace(
+        participate=participate.astype(np.float32),
+        arrival_time=arrival,
+        dropped=a.dropped | b.dropped,
+    )
+
+
 def _all_on(num_clients: int) -> "ClientTrace":
     return ClientTrace(
         participate=np.ones(num_clients, np.float32),

@@ -1,5 +1,6 @@
 from olearning_sim_tpu.engine.client_data import (
     ClientDataset,
+    HostClientStore,
     make_synthetic_dataset,
     make_synthetic_text_dataset,
 )
@@ -25,6 +26,8 @@ from olearning_sim_tpu.engine.fedcore import (
     ServerState,
     build_fedcore,
 )
+from olearning_sim_tpu.engine.scenario import ScenarioConfig, ScenarioModel
+from olearning_sim_tpu.engine.fedcore import StreamStats
 from olearning_sim_tpu.engine.pacing import (
     DeadlineConfig,
     DeadlineController,
@@ -41,9 +44,13 @@ __all__ = [
     "DeadlineMissError",
     "DefenseConfig",
     "FedCore",
+    "HostClientStore",
     "PersonalState",
     "RoundMetrics",
+    "ScenarioConfig",
+    "ScenarioModel",
     "ServerState",
+    "StreamStats",
     "build_fedcore",
     "ditto",
     "fedadagrad",

@@ -89,6 +89,29 @@ class RoundMetrics(struct.PyTreeNode):
     clipped: jnp.ndarray = struct.field(default_factory=lambda: jnp.float32(0.0))
 
 
+@dataclasses.dataclass
+class StreamStats:
+    """Host-side accounting of one block-streamed round
+    (:meth:`FedCore.stream_round`)."""
+
+    blocks: int                  # stream blocks executed
+    block_rows: int              # global clients per stream block
+    rows: int                    # padded population walked
+    transfer_bytes: int          # host->device bytes staged
+    host_transfer_s: float       # wall seconds inside staging calls
+    # Estimated fraction of the steady-state transfer hidden behind
+    # in-flight compute: 1 - (observed staging wall after the first
+    # block / the same bytes at the first (unoverlapped) block's
+    # measured rate). ~0 on synchronous backends (CPU), ->1 when the
+    # runtime overlaps DMA with compute. None for single-block rounds.
+    overlap_fraction: Optional[float]
+    # Peak resident device bytes: params + optimizer state + the partial
+    # aggregate carry + two staged blocks (current + prefetched). The
+    # streamed round's O(block) HBM claim, stated as a number.
+    peak_hbm_bytes_est: int
+    state_bytes: int             # host-resident per-client state bytes
+
+
 class PersonalState(struct.PyTreeNode):
     """Ditto per-client personalized parameters: every leaf has a leading
     client axis [C, ...] sharded over ``dp`` — the rebuild's answer to the
@@ -490,6 +513,11 @@ class FedCore:
         # trace time, never at execution) is the regression probe tests
         # assert that on.
         self._round_step_variants: dict = {(False, False, None): self._round_step}
+        # Block-streamed round programs (stream_round): keyed by
+        # (rows-per-device, with_deadline, with_attack, defense structure)
+        # -> (partial_fn, finalize_fn, zero_acc_fn). Built on first use;
+        # resident-path programs above are untouched by streaming.
+        self._stream_variants: dict = {}
         self.trace_counts: dict = {}
         self._evaluate = self._build_evaluate()
         self._evaluate_personal = None  # built on first use
@@ -2083,6 +2111,621 @@ class FedCore:
         else:
             self._dispatch_warm = True
         return out
+
+    # ------------------------------------------------------- streamed rounds
+    # Block-streamed round execution: the cohort is processed in
+    # device-sized blocks with the partial aggregates carried ON DEVICE
+    # across blocks and the server update applied once at round close, so
+    # peak HBM is O(block) regardless of population size. The per-block
+    # computation reuses the EXACT helper chain of the resident program
+    # (_local_train -> _attack_deltas -> _finite_client_mask ->
+    # _clip_client_deltas -> the same weighted tensordot accumulation),
+    # and the client->device layout interleaves stream blocks so each
+    # device folds ITS monolithic row range in the monolithic order —
+    # which is what makes a >=2-block streamed round bitwise identical to
+    # the resident single-program round (tests/test_streaming.py pins
+    # params, metrics, and per-client losses).
+    def _stream_reject(self, defense):
+        if self.plan.pp > 1 or self.plan.mp > 1:
+            raise ValueError(
+                "streamed rounds run on dp-only meshes: the partial-"
+                "aggregate carry is a manual-dp program (mp>1 runs "
+                "GSPMD-auto end-to-end, pp>1 pipelines the train body; "
+                "docs/performance.md has the composition matrix)"
+            )
+        if self.algorithm.personalized or self.algorithm.control_variates:
+            raise ValueError(
+                f"streamed rounds do not support the personalized/"
+                f"control-variate algorithm {self.algorithm.name!r} "
+                f"(per-client state does not yet stream; keep the "
+                f"population resident)"
+            )
+        if self.config.shard_server_update:
+            raise ValueError(
+                "streamed rounds use the replicated server update; "
+                "fedcore.shard_server_update=true does not compose with "
+                "scenario.stream_block_rows (the round-close stitch "
+                "would need the manual psum_scatter tail per stream "
+                "variant — docs/performance.md composition matrix)"
+            )
+        if defense is not None and defense.gathers_deltas:
+            raise ValueError(
+                "robust aggregators / anomaly scoring do not compose "
+                "with streamed rounds: they need every client's delta "
+                "simultaneously (O(cohort x params)), which is exactly "
+                "the residency streaming removes — use clip_norm only"
+            )
+
+    def _build_stream_step(self, rows_per_device: int,
+                           with_deadline: bool = False,
+                           with_attack: bool = False, defense=None):
+        """Build (partial_fn, finalize_fn, zero_acc_fn) for one streamed
+        program shape. ``partial_fn(params, base_key, round_idx, acc,
+        <block data>, *extras) -> (acc, client_loss)`` advances the
+        partial aggregates over one staged block (the carry is donated —
+        HBM holds one live accumulator); ``finalize_fn(state, acc) ->
+        (state, metrics)`` applies the cross-replica reduction and the
+        server optimizer update once at round close. All per-round knobs
+        (deadline, attack scales, clip norm) are data, exactly like the
+        resident program's."""
+        plan = self.plan
+        cfg = self.config
+        alg = self.algorithm
+        mesh = plan.mesh
+        if rows_per_device % cfg.block_clients != 0:
+            raise ValueError(
+                f"stream rows per device {rows_per_device} must be a "
+                f"multiple of block_clients={cfg.block_clients}"
+            )
+        dkey = defense.structure_key if defense is not None else None
+        trace_key = ("stream", rows_per_device, with_deadline, with_attack,
+                     dkey)
+        fin_key = ("stream_finalize", with_deadline, with_attack, dkey)
+
+        def partial_body(params, base_key, round_idx, acc,
+                         x, y, num_samples, num_steps, uid, weight,
+                         *extras):
+            # Trace-time probe: scenario/stream knob changes across
+            # rounds must never re-trace (same regression contract as
+            # the resident program's trace_counts).
+            self.trace_counts[trace_key] = \
+                self.trace_counts.get(trace_key, 0) + 1
+            extras = list(extras)
+            if defense is not None:
+                (sum_delta, sum_w, sum_loss, count, stragglers,
+                 n_clip) = acc
+            else:
+                sum_delta, sum_w, sum_loss, count, stragglers = acc
+                n_clip = None
+            # Per-device accumulator slices arrive [1, ...]; peel the
+            # leading stream axis.
+            peel = lambda t: jax.tree.map(lambda a: a[0], t)
+            sum_delta = peel(sum_delta)
+            sum_w, sum_loss, count, stragglers = (
+                sum_w[0], sum_loss[0], count[0], stragglers[0]
+            )
+            if n_clip is not None:
+                n_clip = n_clip[0]
+            clip_norm = None
+            if with_deadline:
+                completion_time, deadline = extras[0], extras[1]
+                del extras[:2]
+                late = completion_time > deadline
+                stragglers = stragglers + jnp.logical_and(
+                    weight > 0, late
+                ).sum().astype(jnp.float32)
+                weight = jnp.where(late, jnp.zeros_like(weight), weight)
+            if with_attack:
+                attack_scale = extras.pop(0)
+            if defense is not None:
+                clip_norm = extras[0]
+                del extras[:2]
+            c_local = x.shape[0]
+            nb = c_local // cfg.block_clients
+
+            def blocked(a):
+                return a.reshape((nb, cfg.block_clients) + a.shape[1:])
+
+            xs = (blocked(x), blocked(y), blocked(num_samples),
+                  blocked(num_steps), blocked(uid), blocked(weight),
+                  blocked(attack_scale) if with_attack else None)
+            init = (sum_delta, sum_w, sum_loss, count)
+            if defense is not None:
+                init = init + (n_clip,)
+
+            def block_step(carry, inp):
+                if defense is not None:
+                    sum_delta, sum_w, sum_loss, count, n_clip = carry
+                else:
+                    sum_delta, sum_w, sum_loss, count = carry
+                    n_clip = None
+                bx, by, bns, bst, buid, bw, batk = inp
+                deltas, losses = jax.vmap(
+                    self._local_train,
+                    in_axes=(None, 0, 0, 0, 0, 0, None, None),
+                )(params, bx, by, bns, bst, buid, base_key, round_idx)
+                if with_attack:
+                    deltas = _attack_deltas(deltas, batk)
+                ok = _finite_client_mask(losses, deltas)
+
+                def gate(d):
+                    return jnp.where(
+                        ok.reshape((-1,) + (1,) * (d.ndim - 1)), d, 0.0
+                    )
+
+                bw_eff = jnp.where(ok, bw, 0.0)
+                if defense is not None:
+                    d32 = jax.tree.map(
+                        lambda d: gate(d.astype(jnp.float32)), deltas
+                    )
+                    d32, too_big = _clip_client_deltas(d32, clip_norm)
+                    n_clip = n_clip + jnp.logical_and(
+                        bw_eff > 0, too_big
+                    ).sum().astype(jnp.float32)
+                    sum_delta = jax.tree.map(
+                        lambda s, d: s + jnp.tensordot(bw_eff, d, axes=(0, 0)),
+                        sum_delta, d32,
+                    )
+                else:
+                    sum_delta = jax.tree.map(
+                        lambda s, d: s + jnp.tensordot(
+                            bw_eff, gate(d.astype(jnp.float32)), axes=(0, 0)
+                        ),
+                        sum_delta, deltas,
+                    )
+                sum_w = sum_w + bw_eff.sum()
+                sum_loss = sum_loss + jnp.where(ok, bw * losses, 0.0).sum()
+                count = count + (bw_eff > 0).sum().astype(jnp.float32)
+                new_carry = (sum_delta, sum_w, sum_loss, count)
+                if defense is not None:
+                    new_carry = new_carry + (n_clip,)
+                return new_carry, losses
+
+            carry, block_losses = jax.lax.scan(
+                block_step, init, xs, unroll=min(cfg.block_unroll, nb)
+            )
+            if defense is not None:
+                sum_delta, sum_w, sum_loss, count, n_clip = carry
+            else:
+                sum_delta, sum_w, sum_loss, count = carry
+            client_loss = block_losses.reshape((c_local,))
+            pack = lambda t: jax.tree.map(lambda a: a[None], t)
+            new_acc = (pack(sum_delta), sum_w[None], sum_loss[None],
+                       count[None], stragglers[None])
+            if defense is not None:
+                new_acc = new_acc + (n_clip[None],)
+            return new_acc, client_loss
+
+        def finalize_body(params, opt_state, round_idx, acc):
+            self.trace_counts[fin_key] = \
+                self.trace_counts.get(fin_key, 0) + 1
+            if defense is not None:
+                (sum_delta, sum_w, sum_loss, count, stragglers,
+                 n_clip) = acc
+            else:
+                sum_delta, sum_w, sum_loss, count, stragglers = acc
+                n_clip = None
+            sum_delta = jax.tree.map(lambda a: a[0], sum_delta)
+            sum_w, sum_loss, count, stragglers = (
+                sum_w[0], sum_loss[0], count[0], stragglers[0]
+            )
+            # Cross-replica reduction + server update: the exact tail of
+            # the resident program (each device's partial is its
+            # monolithic scan total, so the psum reduces the identical
+            # operands).
+            sum_w = jax.lax.psum(sum_w, "dp")
+            sum_loss = jax.lax.psum(sum_loss, "dp")
+            count = jax.lax.psum(count, "dp")
+            stragglers = jax.lax.psum(stragglers, "dp")
+            if n_clip is not None:
+                n_clip = jax.lax.psum(n_clip[0], "dp")
+            else:
+                n_clip = jnp.float32(0.0)
+            sum_delta = jax.lax.psum(sum_delta, "dp")
+            denom = jnp.maximum(sum_w, 1e-8)
+            mean_delta = jax.tree.map(lambda s: s / denom, sum_delta)
+            pseudo_grad = jax.tree.map(
+                lambda d, p: (-d).astype(p.dtype), mean_delta, params
+            )
+            updates, new_opt_state = alg.server_optimizer.update(
+                pseudo_grad, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+            metrics = RoundMetrics(
+                mean_loss=sum_loss / denom,
+                weight_sum=sum_w,
+                clients_trained=count,
+                # Assembled host-side from the streamed per-block losses
+                # (the driver replaces this placeholder).
+                client_loss=jnp.float32(0.0),
+                personal_loss=jnp.float32(0.0),
+                stragglers=stragglers,
+                anomaly_score=jnp.float32(0.0),
+                clipped=n_clip,
+            )
+            return new_params, new_opt_state, round_idx + 1, metrics
+
+        rep = P()
+        cl = P("dp")
+        acc_leaf = P("dp")
+        p_shapes = jax.eval_shape(self.init_params_fn, jax.random.key(0))
+        acc_delta_spec = jax.tree.map(lambda _: acc_leaf, p_shapes)
+        acc_specs = (acc_delta_spec, acc_leaf, acc_leaf, acc_leaf, acc_leaf)
+        if defense is not None:
+            acc_specs = acc_specs + (acc_leaf,)
+        pace_specs = (cl, rep) if with_deadline else ()
+        attack_specs = (cl,) if with_attack else ()
+        defense_specs = (rep, rep) if defense is not None else ()
+        extra_specs = pace_specs + attack_specs + defense_specs
+
+        partial_fn = jax.jit(
+            jax.shard_map(
+                partial_body,
+                mesh=mesh,
+                in_specs=(rep, rep, rep, acc_specs, cl, cl, cl, cl, cl,
+                          cl) + extra_specs,
+                out_specs=(acc_specs, cl),
+                axis_names=frozenset({"dp"}),
+            ),
+            donate_argnums=(3,),
+        )
+
+        fin_shard = jax.shard_map(
+            finalize_body,
+            mesh=mesh,
+            in_specs=(rep, rep, rep, acc_specs),
+            out_specs=(rep, rep, rep, jax.tree.map(
+                lambda _: rep,
+                RoundMetrics(
+                    mean_loss=0, weight_sum=0, clients_trained=0,
+                    client_loss=0, personal_loss=0, stragglers=0,
+                    anomaly_score=0, clipped=0,
+                ),
+            )),
+            axis_names=frozenset({"dp"}),
+        )
+
+        # Only the state is donated here: the accumulator's [dp, ...]
+        # leaves cannot alias the (smaller) outputs, and donating them
+        # would just emit an unusable-donation warning per compile; they
+        # die with their last reference the moment this call returns.
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def finalize_fn(state: ServerState, acc):
+            new_params, new_opt, new_round, metrics = fin_shard(
+                state.params, state.opt_state, state.round_idx, acc
+            )
+            return (
+                ServerState(
+                    params=new_params,
+                    opt_state=new_opt,
+                    round_idx=new_round,
+                    base_key=state.base_key,
+                ),
+                metrics,
+            )
+
+        dpn = plan.dp
+        acc_sh = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), acc_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+        def make_zeros():
+            zeros_delta = jax.tree.map(
+                lambda p: jnp.zeros((dpn,) + p.shape, jnp.float32), p_shapes
+            )
+            scalars = [jnp.zeros((dpn,), jnp.float32)
+                       for _ in range(5 if defense is not None else 4)]
+            return (zeros_delta, *scalars)
+
+        zero_acc_fn = jax.jit(make_zeros, out_shardings=acc_sh)
+        return partial_fn, finalize_fn, zero_acc_fn
+
+    def _stream_variant(self, rows_per_device: int, with_deadline: bool,
+                        with_attack: bool, defense):
+        key = (rows_per_device, with_deadline, with_attack,
+               defense.structure_key if defense is not None else None)
+        built = self._stream_variants.get(key)
+        if built is None:
+            built = self._build_stream_step(
+                rows_per_device, with_deadline=with_deadline,
+                with_attack=with_attack, defense=defense,
+            )
+            self._stream_variants[key] = built
+        return built
+
+    def _prepare_stream(self, store, stream_rows: int,
+                        participate=None, num_steps=None,
+                        completion_time=None, deadline=None,
+                        attack_scale=None, defense=None,
+                        label_shift=None, label_classes=None):
+        """Resolve one streamed round's plan: pad the store, normalize the
+        per-client host arrays to the padded population, and return the
+        layout (row segments per block) plus the compiled variant."""
+        plan = self.plan
+        cfg = self.config
+        if defense is not None and not defense.enabled:
+            defense = None
+        self._stream_reject(defense)
+        dpn = plan.dp
+        R = int(stream_rows)
+        if R % (dpn * cfg.block_clients) != 0:
+            raise ValueError(
+                f"stream_block_rows={R} must be a multiple of "
+                f"dp*block_clients={dpn * cfg.block_clients}"
+            )
+        if deadline is None and completion_time is not None:
+            raise ValueError("completion_time given without a deadline")
+        if deadline is not None and completion_time is None:
+            raise ValueError(
+                "deadline given without completion_time; compute one "
+                "with olearning_sim_tpu.engine.pacing.completion_times"
+            )
+        c_pad = pad_to_multiple(
+            max(store.num_real_clients, store.padded_clients), R
+        )
+        store.pad_to(c_pad)
+        cpd = c_pad // dpn
+        rpd = R // dpn
+        nb = c_pad // R
+
+        def full(arr, fill, dtype):
+            if arr is None:
+                return None
+            out = np.full(c_pad, fill, dtype)
+            a = np.asarray(arr)
+            out[: a.shape[0]] = a.astype(dtype, copy=False)
+            return out
+
+        participate = full(participate, 0.0, np.float32)
+        num_steps = full(num_steps, cfg.max_local_steps, np.int32)
+        completion_time = full(completion_time, np.inf, np.float32)
+        attack_scale = full(attack_scale, 1.0, np.float32)
+        if label_shift is not None and label_classes is None:
+            raise ValueError(
+                "label_shift needs label_classes (the drift modulus); "
+                "the scenario layer passes the population's class count"
+            )
+        label_shift = full(label_shift, 0, np.int32)
+
+        def segments(i):
+            """Global row ranges [(start, stop)] per device for stream
+            block ``i`` — the interleaved layout that keeps each device's
+            accumulation chain identical to the resident program's."""
+            return [(d * cpd + i * rpd, d * cpd + (i + 1) * rpd)
+                    for d in range(dpn)]
+
+        with_deadline = deadline is not None
+        with_attack = attack_scale is not None
+        partial_fn, finalize_fn, zero_acc_fn = self._stream_variant(
+            rpd, with_deadline, with_attack, defense
+        )
+        extras_const = ()
+        if defense is not None:
+            clip = defense.clip_norm
+            if clip is None or not np.isfinite(clip):
+                clip = 3.0e38  # finite disabled sentinel — see sync path
+            extras_const = (jnp.float32(clip),
+                            jnp.float32(defense.trim_fraction))
+        return {
+            "c_pad": c_pad, "rpd": rpd, "nb": nb, "R": R,
+            "segments": segments,
+            "participate": participate, "num_steps": num_steps,
+            "completion_time": completion_time, "deadline": deadline,
+            "attack_scale": attack_scale if with_attack else None,
+            "label_shift": label_shift, "label_classes": label_classes,
+            "with_deadline": with_deadline, "with_attack": with_attack,
+            "defense": defense, "extras_const": extras_const,
+            "partial_fn": partial_fn, "finalize_fn": finalize_fn,
+            "zero_acc_fn": zero_acc_fn,
+        }
+
+    def _place_stream_block(self, store, prep, i, feature_dtype):
+        """Stage stream block ``i``: gather the interleaved host rows and
+        place them sharded so device ``d`` receives exactly its
+        monolithic row range's ``i``-th slice. Returns (placed tuple,
+        extras tuple, bytes staged, row index array)."""
+        segs = prep["segments"](i)
+        parts = [store.rows(a, b) for a, b in segs]
+        cat = {k: (np.concatenate([p[k] for p in parts])
+                   if len(parts) > 1 else parts[0][k])
+               for k in parts[0]}
+        x = cat["x"]
+        if feature_dtype is not None and jnp.issubdtype(
+                np.asarray(x).dtype, jnp.floating):
+            x = np.asarray(x).astype(feature_dtype)
+        rows_idx = np.concatenate(
+            [np.arange(a, b) for a, b in segs]
+        ) if len(segs) > 1 else np.arange(segs[0][0], segs[0][1])
+        y = cat["y"]
+        if prep["label_shift"] is not None:
+            # Non-IID label drift: the client's label mapping rotates by
+            # its per-round shift. Labels are data, so drift never
+            # retraces; a zero shift is an exact no-op.
+            shift = prep["label_shift"][rows_idx]
+            if shift.any():
+                y = (np.asarray(y) + shift[:, None]) % int(
+                    prep["label_classes"]
+                )
+                y = y.astype(cat["y"].dtype, copy=False)
+        weight = cat["weight"]
+        if prep["participate"] is not None:
+            weight = weight * prep["participate"][rows_idx]
+        steps = (prep["num_steps"][rows_idx]
+                 if prep["num_steps"] is not None
+                 else np.full(weight.shape[0], self.config.max_local_steps,
+                              np.int32))
+        sh = self.plan.client_sharding()
+        put = lambda a: global_put(np.ascontiguousarray(a), sh)
+        placed = (
+            put(x), put(y),
+            put(np.asarray(cat["num_samples"], np.int32)),
+            put(np.asarray(steps, np.int32)),
+            put(np.asarray(cat["client_uid"], np.int32)),
+            put(np.asarray(weight, np.float32)),
+        )
+        extras = ()
+        if prep["with_deadline"]:
+            extras += (put(prep["completion_time"][rows_idx]),
+                       jnp.float32(prep["deadline"]))
+        if prep["with_attack"]:
+            extras += (put(prep["attack_scale"][rows_idx]),)
+        extras += prep["extras_const"]
+        nbytes = sum(
+            int(np.asarray(a).nbytes) for a in
+            (x, cat["y"], cat["num_samples"], steps, cat["client_uid"],
+             weight)
+        )
+        return placed, extras, nbytes, rows_idx
+
+    def stream_round(self, state: ServerState, store,
+                     stream_rows: Optional[int] = None,
+                     participate=None, num_steps=None,
+                     completion_time=None, deadline=None,
+                     attack_scale=None, defense=None,
+                     label_shift=None, label_classes=None,
+                     feature_dtype=jnp.bfloat16):
+        """Advance one FL round over a host-resident
+        :class:`~olearning_sim_tpu.engine.client_data.HostClientStore`,
+        streaming the cohort through the device in blocks of
+        ``stream_rows`` clients with double-buffered host->device
+        staging (the next block's placement is issued while the current
+        block's compiled step is in flight) and the partial aggregates
+        carried on device. Returns ``(state, metrics, StreamStats)``.
+
+        Per-client inputs (``participate`` / ``num_steps`` /
+        ``completion_time`` / ``attack_scale``) are HOST arrays of length
+        ``num_real_clients`` (or the padded population); scalar knobs
+        match :meth:`round_step`'s semantics exactly. ``feature_dtype``
+        mirrors ``ClientDataset.place`` (bf16 features by default; pass
+        ``None`` for dtype-preserving parity runs).
+
+        Bitwise contract: for the same cohort, padded size, and
+        ``block_clients``, a >=2-block streamed round produces bit-for-bit
+        the params, metrics, and per-client losses of the resident
+        single-program round (regression-tested)."""
+        import time as _time
+
+        from olearning_sim_tpu.telemetry import instrument
+
+        if stream_rows is None:
+            raise ValueError(
+                "stream_round needs stream_rows (scenario."
+                "stream_block_rows when driven by engine params)"
+            )
+        prep = self._prepare_stream(
+            store, stream_rows, participate=participate,
+            num_steps=num_steps, completion_time=completion_time,
+            deadline=deadline, attack_scale=attack_scale, defense=defense,
+            label_shift=label_shift, label_classes=label_classes,
+        )
+        nb = prep["nb"]
+        acc = prep["zero_acc_fn"]()
+        partial_fn = prep["partial_fn"]
+
+        transfer_s = 0.0
+        first_transfer_s = 0.0
+        transfer_bytes = 0
+        block_bytes0 = 0
+        losses = [None] * nb
+        rowmaps = [None] * nb
+
+        t0 = _time.perf_counter()
+        placed, extras, nbytes, rows_idx = self._place_stream_block(
+            store, prep, 0, feature_dtype
+        )
+        first_transfer_s = _time.perf_counter() - t0
+        transfer_s += first_transfer_s
+        transfer_bytes += nbytes
+        block_bytes0 = nbytes
+        for i in range(nb):
+            rowmaps[i] = rows_idx
+            acc, losses[i] = partial_fn(
+                state.params, state.base_key, state.round_idx, acc,
+                *placed, *extras,
+            )
+            if i + 1 < nb:
+                # Double buffering: stage the next block while the
+                # current block's compiled step is in flight. HBM holds
+                # at most two staged blocks (the previous block's
+                # buffers die with their last reference).
+                t0 = _time.perf_counter()
+                placed, extras, nbytes, rows_idx = \
+                    self._place_stream_block(store, prep, i + 1,
+                                             feature_dtype)
+                transfer_s += _time.perf_counter() - t0
+                transfer_bytes += nbytes
+        new_state, metrics = prep["finalize_fn"](state, acc)
+
+        client_loss = np.full(prep["c_pad"], np.nan, np.float32)
+        for i in range(nb):
+            # The streamed round's designed host sync point (the
+            # host_transfer analogue): all blocks + the finalize commit
+            # are already dispatched, and the per-block loss arrays are
+            # private to this walk.
+            client_loss[rowmaps[i]] = np.asarray(
+                jax.device_get(losses[i])  # lint: allow-host-sync
+            )
+        metrics = metrics.replace(client_loss=client_loss)
+
+        overlap = None
+        if nb > 1 and first_transfer_s > 0 and block_bytes0 > 0:
+            rate = block_bytes0 / first_transfer_s
+            est_rest = (transfer_bytes - block_bytes0) / rate
+            seen_rest = transfer_s - first_transfer_s
+            if est_rest > 0:
+                overlap = float(np.clip(1.0 - seen_rest / est_rest,
+                                        0.0, 1.0))
+        params_bytes = sum(
+            int(np.prod(l.shape, dtype=np.int64)) * l.dtype.itemsize
+            for l in jax.tree.leaves(new_state.params)
+        )
+        opt_bytes = sum(
+            int(np.prod(getattr(l, "shape", ()), dtype=np.int64))
+            * getattr(l, "dtype", np.dtype(np.float32)).itemsize
+            for l in jax.tree.leaves(new_state.opt_state)
+        )
+        acc_bytes = sum(
+            int(np.prod(l.shape, dtype=np.int64)) * l.dtype.itemsize
+            for l in jax.tree.leaves(acc)
+        )
+        stats = StreamStats(
+            blocks=nb,
+            block_rows=prep["R"],
+            rows=prep["c_pad"],
+            transfer_bytes=transfer_bytes,
+            host_transfer_s=round(transfer_s, 6),
+            overlap_fraction=overlap,
+            peak_hbm_bytes_est=int(params_bytes + opt_bytes + acc_bytes
+                                   + 2 * block_bytes0),
+            state_bytes=store.state_bytes(),
+        )
+        instrument("ols_engine_host_transfer_seconds_total").labels(
+            algorithm=self.algorithm.name
+        ).inc(transfer_s)
+        instrument("ols_engine_stream_blocks_total").labels(
+            algorithm=self.algorithm.name
+        ).inc(nb)
+        instrument("ols_engine_client_state_bytes").labels(
+            algorithm=self.algorithm.name
+        ).set(store.state_bytes())
+        return new_state, metrics, stats
+
+    def lower_stream_step(self, state: ServerState, store,
+                          stream_rows: int, feature_dtype=jnp.bfloat16,
+                          **kwargs):
+        """AOT-lower the streamed PARTIAL program for these arguments
+        (block 0) without executing it — the streamed analogue of
+        :meth:`lower_round_step`, consumed by ``analysis/grid``."""
+        prep = self._prepare_stream(store, stream_rows, **kwargs)
+        placed, extras, _, _ = self._place_stream_block(
+            store, prep, 0, feature_dtype
+        )
+        acc = prep["zero_acc_fn"]()
+        return prep["partial_fn"].lower(
+            state.params, state.base_key, state.round_idx, acc,
+            *placed, *extras,
+        )
 
     # ----------------------------------------------------------------- eval
     def _build_evaluate(self):

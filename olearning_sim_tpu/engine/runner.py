@@ -45,8 +45,13 @@ import jax
 import numpy as np
 
 from olearning_sim_tpu.deviceflow.service import DeviceFlowService
-from olearning_sim_tpu.deviceflow.trace_compiler import ClientTrace, compile_trace
-from olearning_sim_tpu.engine.client_data import ClientDataset
+from olearning_sim_tpu.deviceflow.trace_compiler import (
+    ClientTrace,
+    combine_traces,
+    compile_trace,
+)
+from olearning_sim_tpu.engine.client_data import ClientDataset, HostClientStore
+from olearning_sim_tpu.engine.scenario import ScenarioConfig, ScenarioModel
 from olearning_sim_tpu.engine.defense import DefenseConfig
 from olearning_sim_tpu.engine.fedcore import FedCore
 from olearning_sim_tpu.engine import pacing
@@ -118,6 +123,17 @@ class DataPopulation:
     # device-tier speed differences (high/mid/low phones) enter the compiled
     # program — as masked step counts, not separate programs.
     num_steps: Optional[np.ndarray] = None
+    # The population's label-class count (the scenario label-drift
+    # modulus). None falls back to observed max(y)+1 — correct only when
+    # the cohort's labels cover every class, so builders that know the
+    # real count (task_bridge) set it.
+    num_classes: Optional[int] = None
+    # Block-streamed population (scenario.stream_block_rows): the cohort
+    # lives host-resident in this store and train rounds run through
+    # ``FedCore.stream_round`` (O(block) HBM). ``dataset`` then holds the
+    # HOST arrays (never placed); populations without a store keep the
+    # resident placed-dataset path bit-for-bit.
+    store: Optional[HostClientStore] = None
 
 
 class SimulationRunner:
@@ -146,6 +162,7 @@ class SimulationRunner:
         defense: Optional[DefenseConfig] = None,
         quarantine_preseed: Optional[Dict[str, List[int]]] = None,
         async_config: Optional[Any] = None,
+        scenario: Optional[ScenarioConfig] = None,
     ):
         """``model_io`` — a :class:`ModelUpdateExporter` realizing the
         reference's model-update-style convention (round r's global model
@@ -308,6 +325,34 @@ class SimulationRunner:
         # meta, so rollback/resume replays the commit sequence exactly
         # (_reasync), like quarantine state and the deadline controller.
         self._async_commit_clock = 0
+        # Scenario traces (engine/scenario.py): day-scale availability
+        # masks (diurnal/charging/spike/churn) multiplied into each train
+        # round's participation, arrival times combined into the pacing
+        # model, and label drift applied as scoped placed-array swaps.
+        # A trace is a pure function of (config, trace_seed, round), so
+        # rollback/resume/supervisor relaunch replay the exact sets with
+        # no persisted scenario state — the round index IS the cursor.
+        self.scenario = scenario
+        self._scenario_models: Dict[str, ScenarioModel] = {}
+        if self.scenario is not None and self.scenario.streamed:
+            if self.async_config is not None:
+                raise ValueError(
+                    "streamed scenario populations do not compose with "
+                    "buffered async rounds (the commit-window scan needs "
+                    "the whole cohort resident; docs/performance.md)"
+                )
+            if core.algorithm.personalized or core.algorithm.control_variates:
+                raise ValueError(
+                    f"streamed scenario populations do not support the "
+                    f"personalized/control-variate algorithm "
+                    f"{core.algorithm.name!r}"
+                )
+            if self.defense is not None and self.defense.gathers_deltas:
+                raise ValueError(
+                    "streamed scenario populations support clip-only "
+                    "defense: robust aggregators / anomaly scoring need "
+                    "every client's delta resident (docs/performance.md)"
+                )
         # run()-loop state for the cooperative stepping API (begin/step/
         # finish) the MultiTaskDispatcher drives; None outside a run.
         self._loop: Optional[Dict[str, Any]] = None
@@ -543,6 +588,14 @@ class SimulationRunner:
                 seed=self.trace_seed,
             )
             real = p.dataset.num_real_clients
+            strace = None
+            if self.scenario is not None:
+                # Scenario availability (diurnal/charging/spike/churn)
+                # intersects the dispatch-strategy trace: a client
+                # participates only if both release it, and arrives at
+                # the later of the two times (feeds pacing/async).
+                strace = self._scenario_model(p).round_trace(round_idx)
+                trace = combine_traces(trace, strace.as_client_trace())
             mask = np.zeros(p.dataset.num_clients, trace.participate.dtype)
             mask[:real] = trace.participate
             if self._quarantine is not None:
@@ -597,18 +650,30 @@ class SimulationRunner:
                 # Over-selection: non-selected eligible clients sit this
                 # round out (indistinguishable from churn to the program).
                 mask[:real] = np.where(pace.selected, mask[:real], 0)
-                comp_full = np.full(p.dataset.num_clients, np.inf, np.float32)
-                comp_full[:real] = pace.completion
-                completion_dev = global_put(
-                    comp_full, self.core.plan.client_sharding()
+                if p.store is None:
+                    comp_full = np.full(p.dataset.num_clients, np.inf,
+                                        np.float32)
+                    comp_full[:real] = pace.completion
+                    completion_dev = global_put(
+                        comp_full, self.core.plan.client_sharding()
+                    )
+            participate = num_steps = None
+            if p.store is None:
+                participate = global_put(
+                    mask, self.core.plan.client_sharding()
                 )
-            participate = global_put(mask, self.core.plan.client_sharding())
-            num_steps = None
-            if p.num_steps is not None:
-                num_steps = global_put(
-                    np.asarray(p.num_steps, np.int32),
-                    self.core.plan.client_sharding(),
-                )
+                if p.num_steps is not None:
+                    num_steps = global_put(
+                        np.asarray(p.num_steps, np.int32),
+                        self.core.plan.client_sharding(),
+                    )
+        if p.store is not None:
+            # Streamed population: per-client arrays stay on the host —
+            # FedCore.stream_round stages the cohort block by block with
+            # the partial aggregates carried on device (O(block) HBM).
+            return self._run_train_streamed(
+                p, round_idx, operator, trace, strace, mask, pace
+            )
         t_step0 = time.perf_counter()
         with self._phase(operator.name, "train", round_idx):
             state = self.states[p.name]
@@ -627,17 +692,30 @@ class SimulationRunner:
                 )
             if self.defense is not None:
                 pace_kwargs["defense"] = self.defense
+            y_swap = (atk["y"] if atk is not None and atk["y"] is not None
+                      else None)
+            if (strace is not None and strace.label_shift is not None
+                    and strace.label_shift.any()):
+                # Scenario label drift, scoped to THIS train launch like
+                # the label-flip attack (and composing with it: drift
+                # rotates whatever labels the round would otherwise
+                # train on). Labels are data — no retrace.
+                base = (y_swap if y_swap is not None
+                        else self._host_labels(p))
+                y_swap = self._drift_labels(p, base, strace.label_shift,
+                                            real)
             clean_y_dev = None
-            if atk is not None and atk["y"] is not None:
-                # Label-flip attack, scoped to THIS train launch: only the
-                # placed label array is swapped (features and the rest of
-                # the dataset stay as-is), and the finally re-installs the
-                # original device buffer — zero re-transfer, and same-round
-                # eval operators / later rounds see clean labels.
+            if y_swap is not None:
+                # Label swap scoped to this train launch: only the placed
+                # label array is swapped (features and the rest of the
+                # dataset stay as-is), and the finally re-installs the
+                # original device buffer — zero re-transfer, and
+                # same-round eval operators / later rounds see clean
+                # labels.
                 clean_y_dev = p.dataset.y
                 p.dataset = dataclasses.replace(
                     p.dataset,
-                    y=global_put(atk["y"], clean_y_dev.sharding),
+                    y=global_put(y_swap, clean_y_dev.sharding),
                 )
             try:
                 if self.core.algorithm.personalized:
@@ -772,6 +850,11 @@ class SimulationRunner:
         if atk is not None:
             rec["attacked"] = len(atk["clients"])
             rec["attack_mode"] = atk["mode"]
+        if strace is not None:
+            # Scenario digest rides the per-round history record (and
+            # therefore checkpoint meta): availability/churn/drift counts
+            # of the trace this round actually trained under.
+            rec["scenario"] = strace.counts()
         if pace is not None:
             # Stragglers of record come from the compiled program's own
             # deadline mask (metrics.stragglers) — the aggregation's truth,
@@ -854,6 +937,173 @@ class SimulationRunner:
             ).labels(task_id=self.task_id, mode="async").inc(idle)
         if self.core.algorithm.personalized:
             rec["personal_loss"] = float(metrics.personal_loss)
+        return rec
+
+    # ------------------------------------------------- scenario / streaming
+    def _scenario_model(self, p: DataPopulation) -> ScenarioModel:
+        """One ScenarioModel per population, built lazily (static per-
+        client draws are seeded by trace_seed, so every process — and
+        every supervisor relaunch — realizes the identical fleet)."""
+        m = self._scenario_models.get(p.name)
+        if m is None:
+            m = ScenarioModel(
+                self.scenario,
+                p.dataset.num_real_clients,
+                seed=self.trace_seed,
+                class_of_client=p.class_of_client,
+                device_classes=p.device_classes,
+            )
+            self._scenario_models[p.name] = m
+        return m
+
+    def _host_labels(self, p: DataPopulation) -> np.ndarray:
+        """Clean host label array (cached; shared with label_flip)."""
+        if p.name not in self._clean_y:
+            self._clean_y[p.name] = np.asarray(
+                jax.device_get(p.dataset.y)
+            ).copy()
+        return self._clean_y[p.name]
+
+    @staticmethod
+    def _label_classes(p: DataPopulation, base: np.ndarray) -> int:
+        """The label-drift modulus: the population's configured class
+        count when the builder supplied it, else observed max(y)+1 (a
+        cohort whose labels miss the top class would otherwise rotate
+        with the wrong modulus)."""
+        return (int(p.num_classes) if p.num_classes
+                else int(np.asarray(base).max()) + 1)
+
+    def _drift_labels(self, p: DataPopulation, base: np.ndarray,
+                      shift: np.ndarray, real: int) -> np.ndarray:
+        """Rotate the first ``real`` clients' labels by their per-client
+        drift shift (mod the population's class count)."""
+        n_cls = self._label_classes(p, base)
+        y = np.array(base)
+        y[:real] = (base[:real] + shift[:real, None]) % n_cls
+        return y.astype(base.dtype, copy=False)
+
+    def _run_train_streamed(self, p: DataPopulation, round_idx: int,
+                            operator: OperatorSpec, trace: ClientTrace,
+                            strace, mask: np.ndarray,
+                            pace: Optional[RoundPacing]) -> Dict[str, Any]:
+        """Train-round body for a block-streamed population
+        (``scenario.stream_block_rows``): same accounting contract as the
+        resident path, with per-client inputs handed to
+        ``FedCore.stream_round`` as host arrays. Label-flip attacks and
+        NaN poisoning are resident-path-only (they swap placed buffers);
+        sign-flip/scale attacks, clip defense, deadline masking, and
+        label drift all compose."""
+        from olearning_sim_tpu.telemetry import instrument
+
+        real = p.dataset.num_real_clients
+        kwargs: Dict[str, Any] = {}
+        if pace is not None:
+            kwargs.update(completion_time=pace.completion,
+                          deadline=pace.deadline_s)
+        atk = self._attacks.get(p.name)
+        if atk is not None and atk["scale"] is not None:
+            kwargs["attack_scale"] = atk["scale"][:real]
+        if atk is not None and atk["y"] is not None:
+            self.logger.warning(
+                task_id=self.task_id, system_name="engine",
+                module_name="runner",
+                message=f"label_flip attack skipped for streamed "
+                        f"population {p.name} (labels stream from the "
+                        f"host store; use sign_flip/scale)",
+            )
+        if self.defense is not None:
+            kwargs["defense"] = self.defense
+        if (strace is not None and strace.label_shift is not None
+                and strace.label_shift.any()):
+            kwargs["label_shift"] = strace.label_shift
+            kwargs["label_classes"] = self._label_classes(p, p.dataset.y)
+        t_step0 = time.perf_counter()
+        with self._phase(operator.name, "train", round_idx):
+            state = self.states[p.name]
+            state, metrics, sstats = self.core.stream_round(
+                state, p.store,
+                stream_rows=self.scenario.stream_block_rows,
+                participate=mask[:real], num_steps=p.num_steps,
+                **kwargs,
+            )
+            self.states[p.name] = state
+        with self._phase(operator.name, "host_transfer", round_idx):
+            client_loss = np.asarray(jax.device_get(metrics.client_loss))
+        if operator.name not in self._compiled_once:
+            self._compiled_once.add(operator.name)
+            instrument(
+                "ols_engine_compile_duration_seconds", self.registry
+            ).labels(task_id=self.task_id, operator=operator.name).set(
+                time.perf_counter() - t_step0
+            )
+        ok = np.isfinite(client_loss)
+        clipped = 0
+        if self.defense is not None:
+            clipped = int(metrics.clipped)
+            if clipped:
+                instrument("ols_engine_clipped_total", self.registry).labels(
+                    task_id=self.task_id
+                ).inc(clipped)
+        if self._quarantine is not None:
+            self._quarantine.observe(
+                p.name, round_idx, mask[:real] > 0, ok[:real]
+            )
+            for ci in self._quarantine.quarantined(p.name):
+                if ci < len(ok):
+                    ok[ci] = False
+            instrument(
+                "ols_engine_quarantined_clients", self.registry
+            ).labels(task_id=self.task_id).set(
+                self._quarantine.num_quarantined()
+            )
+        rec = {
+            "mean_loss": float(metrics.mean_loss),
+            "clients_trained": int(metrics.clients_trained),
+            "released": trace.num_released,
+            "dropped": trace.num_dropped,
+            "sim_duration_s": trace.round_duration(),
+            "ok_mask": ok,
+            # The stream cursor of the COMMITTED round rides checkpoint
+            # meta: rounds are atomic (one server commit at round close),
+            # so a crash mid-stream replays from the previous round and
+            # a completed round records its full block walk.
+            "stream": {
+                "blocks": sstats.blocks,
+                "cursor": sstats.blocks,
+                "block_rows": sstats.block_rows,
+                "rows": sstats.rows,
+                "host_transfer_s": sstats.host_transfer_s,
+                "transfer_bytes": sstats.transfer_bytes,
+                "overlap_fraction": sstats.overlap_fraction,
+                "peak_hbm_bytes_est": sstats.peak_hbm_bytes_est,
+            },
+        }
+        if self.defense is not None:
+            rec["clipped"] = clipped
+            rec["flagged"] = 0
+        if atk is not None and atk["scale"] is not None:
+            rec["attacked"] = len(atk["clients"])
+            rec["attack_mode"] = atk["mode"]
+        if strace is not None:
+            rec["scenario"] = strace.counts()
+        if pace is not None:
+            stragglers = int(metrics.stragglers)
+            rec.update(
+                selected=pace.n_selected,
+                on_time=pace.n_on_time,
+                stragglers=stragglers,
+                deadline_s=(pace.deadline_s
+                            if np.isfinite(pace.deadline_s) else None),
+                round_close_s=pace.round_close_s(),
+            )
+            instrument("ols_engine_stragglers_total", self.registry).labels(
+                task_id=self.task_id
+            ).inc(stragglers)
+            finite = pace.completion[np.isfinite(pace.completion)]
+            instrument(
+                "ols_engine_completion_time_seconds", self.registry
+            ).labels(task_id=self.task_id).observe_many(finite)
+            self._pacer.observe(finite)
         return rec
 
     def _run_eval(self, p: DataPopulation) -> Dict[str, Any]:
@@ -1178,6 +1428,15 @@ class SimulationRunner:
         pop_name = payload.get("population")
         for p in self.populations:
             if pop_name and p.name != pop_name:
+                continue
+            if p.store is not None:
+                self.logger.warning(
+                    task_id=self.task_id, system_name="engine",
+                    module_name="runner",
+                    message=f"poison_clients: population {p.name} is "
+                            f"streamed (host store); NaN poisoning "
+                            f"skipped",
+                )
                 continue
             ds = p.dataset
             x = np.array(jax.device_get(ds.x))

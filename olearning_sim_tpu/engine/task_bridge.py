@@ -201,6 +201,22 @@ def build_runner_from_taskconfig(
         microbatches=parallel.microbatches,
     )
 
+    # Scenario traces + streamed cohorts ride the same blob
+    # (docs/performance.md):
+    #   {"scenario": {"online_base": 0.4, "online_amp": 0.3,
+    #                 "spikes": [{"round": 3, "rounds": 2, "boost": 3.0}],
+    #                 "leave_rate": 0.001, "drift_period_rounds": 20,
+    #                 "stream_block_rows": 2048}}
+    # With stream_block_rows the population stays HOST-resident
+    # (HostClientStore) and train rounds run block-streamed
+    # (FedCore.stream_round — O(block) HBM); without it scenario masks
+    # apply to the ordinary resident program.
+    scenario = None
+    if params.get("scenario"):
+        from olearning_sim_tpu.engine.scenario import ScenarioConfig
+
+        scenario = ScenarioConfig.from_dict(params["scenario"])
+
     from olearning_sim_tpu.models import get_model
 
     spec = get_model(model_cfg.get("name", "mlp2"))
@@ -255,6 +271,7 @@ def build_runner_from_taskconfig(
             dynamic = [0] * len(nums)
         num_clients = sum(nums)
         eval_data = None
+        pop_classes = num_classes
         if td.dataPath:
             # Real dataset: honor dataPath + dataTransferType (reference
             # download_data_files, utils_run_task.py:174-325). The archive's
@@ -283,6 +300,7 @@ def build_runner_from_taskconfig(
                     f"dataset at {td.dataPath!r} has {data_classes} classes "
                     f"but the model's head emits only {model_classes}"
                 )
+            pop_classes = data_classes
         elif is_text:
             ds = make_synthetic_text_dataset(
                 seed=int(syn.get("seed", 0)),
@@ -303,7 +321,15 @@ def build_runner_from_taskconfig(
                 dirichlet_alpha=syn.get("dirichlet_alpha"),
                 class_sep=float(syn.get("class_sep", 2.0)),
             )
-        ds = ds.pad_for(plan, cfg.block_clients).place(plan)
+        store = None
+        if scenario is not None and scenario.streamed:
+            # Streamed population: never placed whole — the round engine
+            # streams device-sized blocks from this host store.
+            from olearning_sim_tpu.engine.client_data import HostClientStore
+
+            store = HostClientStore.from_dataset(ds)
+        else:
+            ds = ds.pad_for(plan, cfg.block_clients).place(plan)
         cls = np.zeros(ds.num_clients, int)
         start = 0
         for ci, n in enumerate(nums):
@@ -340,6 +366,8 @@ def build_runner_from_taskconfig(
                 dynamic_nums=dynamic,
                 eval_data=eval_data,
                 num_steps=num_steps,
+                store=store,
+                num_classes=pop_classes,
             )
         )
 
@@ -494,4 +522,5 @@ def build_runner_from_taskconfig(
         defense=defense,
         quarantine_preseed=quarantine_preseed,
         async_config=async_config,
+        scenario=scenario,
     )

@@ -188,6 +188,9 @@ def validate_correctness(request) -> Tuple[bool, str]:
                         from olearning_sim_tpu.engine.pacing import (
                             DeadlineConfig,
                         )
+                        from olearning_sim_tpu.engine.scenario import (
+                            ScenarioConfig,
+                        )
                         from olearning_sim_tpu.parallel.mesh import (
                             ParallelConfig,
                         )
@@ -221,6 +224,7 @@ def validate_correctness(request) -> Tuple[bool, str]:
                             ("quarantine", parse_quarantine_params),
                             ("async", AsyncConfig.from_dict),
                             ("parallel", ParallelConfig.from_dict),
+                            ("scenario", ScenarioConfig.from_dict),
                         ):
                             if not op_params.get(block):
                                 continue
@@ -247,6 +251,68 @@ def validate_correctness(request) -> Tuple[bool, str]:
                                     f"scoring is not supported with the "
                                     f"control-variate algorithm {name!r} "
                                     f"(use clip_norm only)",
+                                )
+                            if block == "scenario" and parsed.streamed:
+                                # Streamed cohort composition matrix
+                                # (docs/performance.md): the engine
+                                # rejects these pairs at build time;
+                                # catch them at submit instead.
+                                name, personalized, control = \
+                                    _algo_traits(op_params)
+                                _req(
+                                    not (personalized or control),
+                                    f"operator {op.name} scenario params "
+                                    f"invalid: streamed cohorts "
+                                    f"(stream_block_rows) do not support "
+                                    f"the personalized / control-variate "
+                                    f"algorithm {name!r}",
+                                )
+                                _req(
+                                    not op_params.get("async"),
+                                    f"operator {op.name} scenario params "
+                                    f"invalid: streamed cohorts do not "
+                                    f"compose with buffered async rounds "
+                                    f"(the commit-window scan needs the "
+                                    f"whole cohort resident)",
+                                )
+                                dfs = op_params.get("defense")
+                                gathers = False
+                                if dfs:
+                                    try:
+                                        gathers = DefenseConfig \
+                                            .from_dict(dfs).gathers_deltas
+                                    except Exception:  # noqa: BLE001
+                                        gathers = False  # fails above
+                                _req(
+                                    not gathers,
+                                    f"operator {op.name} scenario params "
+                                    f"invalid: streamed cohorts support "
+                                    f"clip-only defense (robust "
+                                    f"aggregators / anomaly scoring need "
+                                    f"every delta resident)",
+                                )
+                                par = op_params.get("parallel")
+                                par_on = False
+                                if par:
+                                    try:
+                                        par_on = ParallelConfig \
+                                            .from_dict(par).enabled
+                                    except Exception:  # noqa: BLE001
+                                        par_on = False  # fails above
+                                _req(
+                                    not par_on,
+                                    f"operator {op.name} scenario params "
+                                    f"invalid: streamed cohorts run on "
+                                    f"dp-only meshes (no parallel "
+                                    f"mp/pp block)",
+                                )
+                                fed = op_params.get("fedcore") or {}
+                                _req(
+                                    not fed.get("shard_server_update"),
+                                    f"operator {op.name} scenario params "
+                                    f"invalid: streamed cohorts use the "
+                                    f"replicated server update (no "
+                                    f"fedcore.shard_server_update)",
                                 )
                             if block == "async":
                                 # The buffered engine's lateness control

@@ -168,6 +168,27 @@ CATALOG = {
         "engine drives toward ~0",
         ("task_id", "mode"),
     ),
+    "ols_engine_host_transfer_seconds_total": (
+        COUNTER,
+        "Wall seconds spent staging streamed cohort blocks host->device "
+        "(FedCore.stream_round double-buffered placement; compare with "
+        "round wall time for transfer exposure)",
+        ("algorithm",),
+    ),
+    "ols_engine_stream_blocks_total": (
+        COUNTER,
+        "Cohort blocks executed by the streamed round engine (one "
+        "compiled partial step per block; population / stream_block_rows "
+        "per round)",
+        ("algorithm",),
+    ),
+    "ols_engine_client_state_bytes": (
+        GAUGE,
+        "Host-resident persistent per-client state bytes held by the "
+        "streamed population's HostClientStore (quarantine strikes, "
+        "pacing EMAs, personalization state)",
+        ("algorithm",),
+    ),
     "ols_engine_compile_cache_hits_total": (
         COUNTER,
         "Compiled executables deserialized from the persistent XLA "

@@ -37,6 +37,19 @@ def wait_until(cond, timeout=10.0):
 
 
 # ------------------------------------------------------------ fake servers
+# The WebSocket fake servers need the ``websockets`` package (the
+# *server* half; the production WebsocketProducer only needs
+# ``websocket-client``). Minimal images ship without it, so the
+# ws-server-backed tests importorskip instead of erroring — tier-1 must
+# stay clean where only the gRPC stack is installed.
+def _require_ws_server():
+    pytest.importorskip(
+        "websockets",
+        reason="websockets (server package) not installed — the "
+               "WebSocket fake-server tests need websockets.sync.server",
+    )
+
+
 class WsCollector:
     """Real WebSocket server collecting text frames (websockets.sync)."""
 
@@ -133,6 +146,7 @@ class GrpcSink:
 
 # -------------------------------------------------------------- producers
 def test_websocket_producer_pulsar_ws_format():
+    _require_ws_server()
     srv = WsCollector()
     try:
         prod = WebsocketProducer(srv.url)
@@ -182,6 +196,7 @@ def test_factory_dispatch():
 def test_deviceflow_streams_to_external_websocket():
     """External aggregator receives the dispatched behavior-shaped stream
     over the network — the cluster-mode path end to end."""
+    _require_ws_server()
     srv = WsCollector()
     svc = DeviceFlowService(poll_interval=0.01)
     svc.start()
@@ -243,6 +258,7 @@ def test_deviceflow_streams_to_grpc_sink():
 
 # ----------------------------------------------- selection-service barrier
 def test_websocket_round_provider_and_barrier():
+    _require_ws_server()
     srv = WsRoundService()
     try:
         provider = WebsocketRoundProvider(srv.url, query={"task": "t1"})

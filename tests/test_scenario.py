@@ -1,0 +1,267 @@
+"""Scenario traces (engine/scenario.py): numpy oracles and composition.
+
+The determinism contract is the headline: a trace is a pure function of
+(config, seed, num_clients, round_idx), pinned here by an INDEPENDENT
+reimplementation of the documented model (seed streams, draw order,
+formulas) — exact per-round participate/arrival/churn sets, not
+statistics. Plus: config validation (unknown-key rejection), permanent
+churn semantics, spike/charging behavior, drift staggering, and the
+ClientTrace combination used by the runner.
+"""
+
+import numpy as np
+import pytest
+
+from olearning_sim_tpu.deviceflow.trace_compiler import (
+    ClientTrace,
+    combine_traces,
+)
+from olearning_sim_tpu.engine.scenario import (
+    ScenarioConfig,
+    ScenarioModel,
+    SpikeSpec,
+)
+
+C = 500
+SEED = 11
+
+
+# ------------------------------------------------------------- validation
+def test_config_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown scenario config keys"):
+        ScenarioConfig.from_dict({"online_bias": 0.5})
+    with pytest.raises(TypeError):
+        ScenarioConfig.from_dict("not a dict")
+
+
+def test_config_range_validation():
+    with pytest.raises(ValueError):
+        ScenarioConfig(online_base=1.5)
+    with pytest.raises(ValueError):
+        ScenarioConfig(leave_rate=1.0)
+    with pytest.raises(ValueError):
+        ScenarioConfig(round_seconds=0.0)
+    with pytest.raises(ValueError):
+        ScenarioConfig(drift_period_rounds=0)
+    with pytest.raises(ValueError):
+        ScenarioConfig(stream_block_rows=0)
+
+
+def test_spike_spec_validation():
+    with pytest.raises(ValueError, match="unknown scenario spike keys"):
+        SpikeSpec.from_dict({"round": 1, "boots": 2.0})
+    with pytest.raises(ValueError, match="needs a start 'round'"):
+        SpikeSpec.from_dict({"boost": 2.0})
+    with pytest.raises(ValueError):
+        SpikeSpec(round=-1)
+    s = SpikeSpec.from_dict({"round": 3, "rounds": 2, "boost": 4.0})
+    assert s.covers(3) and s.covers(4) and not s.covers(5)
+
+
+def test_from_dict_round_trip():
+    cfg = ScenarioConfig.from_dict({
+        "online_base": 0.4, "online_amp": 0.3, "peak_hour": 21.0,
+        "class_phase_hours": {"low": 6.0},
+        "spikes": [{"round": 2, "boost": 3.0}],
+        "leave_rate": 0.01, "join_frac": 0.2,
+        "drift_period_rounds": 10, "stream_block_rows": 64,
+    })
+    assert cfg.streamed
+    assert cfg.spikes[0].round == 2
+    assert cfg.class_phase_hours["low"] == 6.0
+    assert not ScenarioConfig().streamed
+
+
+# ------------------------------------------------------------ numpy oracle
+def test_round_trace_matches_independent_oracle():
+    """Exact per-round participate/arrival/alive sets for a fixed seed,
+    recomputed here from the documented seed streams and formulas —
+    independent of the implementation's internals."""
+    cfg = ScenarioConfig(
+        round_seconds=3600.0, online_base=0.5, online_amp=0.3,
+        peak_hour=10.0, charging_required=True, charging_hours=6.0,
+        leave_rate=0.01, join_frac=0.2, join_rate=0.1,
+        spikes=(SpikeSpec(round=7, rounds=1, boost=2.0),),
+    )
+    m = ScenarioModel(cfg, C, seed=SEED)
+
+    # --- independent oracle ------------------------------------------
+    rng = np.random.default_rng([SEED, 0x5CE9A10])
+    _jitter = rng.uniform(-1.0, 1.0, C) * 0.0  # phase_jitter_hours = 0
+    charge_start = rng.uniform(0.0, 24.0, C)
+    u_leave = rng.random(C)
+    u_member = rng.random(C)
+    u_join = rng.random(C)
+    leave_round = np.floor(np.log(u_leave) / np.log1p(-0.01)) + 1.0
+    joiner = u_member < 0.2
+    join_round = np.zeros(C)
+    join_round[joiner] = np.floor(
+        np.log(u_join[joiner]) / np.log1p(-0.1)
+    ) + 1.0
+
+    for r in (0, 3, 7, 25):
+        rr = np.random.default_rng([SEED, 0x5CE9A11, r])
+        online_u = rr.random(C)
+        arrival_u = rr.random(C)
+        h = (r * 3600.0 % 86400.0) / 86400.0 * 24.0
+        p = 0.5 + 0.3 * np.cos(2 * np.pi * (h - 10.0) / 24.0)
+        if r == 7:
+            p = p * 2.0
+        p = np.clip(p, 0.0, 1.0)
+        online = online_u < p
+        alive = (join_round <= r) & (r < leave_round)
+        charging = ((h - charge_start) % 24.0) < 6.0
+        participate = alive & online & charging
+        arrival = np.where(participate, arrival_u * 3600.0,
+                           np.inf).astype(np.float32)
+
+        tr = m.round_trace(r)
+        np.testing.assert_array_equal(
+            tr.participate, participate.astype(np.float32)
+        )
+        np.testing.assert_array_equal(tr.arrival_time, arrival)
+        np.testing.assert_array_equal(tr.alive, alive)
+        np.testing.assert_array_equal(tr.online, online)
+        assert tr.counts()["available"] == int(participate.sum())
+        assert tr.counts()["churned"] == int((~alive).sum())
+
+
+def test_determinism_and_seed_separation():
+    cfg = ScenarioConfig(online_base=0.5, online_amp=0.4, leave_rate=0.005)
+    a = ScenarioModel(cfg, C, seed=3).round_trace(4)
+    b = ScenarioModel(cfg, C, seed=3).round_trace(4)
+    np.testing.assert_array_equal(a.participate, b.participate)
+    np.testing.assert_array_equal(a.arrival_time, b.arrival_time)
+    c = ScenarioModel(cfg, C, seed=4).round_trace(4)
+    assert not (a.participate == c.participate).all()
+
+
+# ----------------------------------------------------------------- churn
+def test_churn_is_permanent():
+    """A left client never returns; a late joiner, once joined, stays
+    (modulo its own later leave)."""
+    cfg = ScenarioConfig(leave_rate=0.05, join_frac=0.3, join_rate=0.2)
+    m = ScenarioModel(cfg, 200, seed=1)
+    alive = np.stack([m.round_trace(r).alive for r in range(40)])
+    # Per client: alive must be one contiguous [join, leave) interval —
+    # i.e. the sequence False*..True*..False* with no second True run.
+    for c in range(200):
+        runs = np.flatnonzero(np.diff(alive[:, c].astype(int)) != 0)
+        assert len(runs) <= 2, f"client {c} churned non-monotonically"
+    # Churn actually happens both ways for this config.
+    assert alive[0].sum() > alive[39].sum() - 30  # leavers exist
+    assert (~alive[0] & alive[39]).sum() > 0      # joiners exist
+
+
+def test_offline_clients_are_masked_not_churned():
+    cfg = ScenarioConfig(online_base=0.3)
+    m = ScenarioModel(cfg, 300, seed=2)
+    tr = m.round_trace(0)
+    assert tr.alive.all()
+    assert 0 < tr.num_available < 300
+    assert tr.counts()["offline"] == 300 - tr.num_available
+
+
+# ----------------------------------------------------------------- spikes
+def test_flash_crowd_spike_boosts_participation():
+    cfg = ScenarioConfig(online_base=0.25,
+                         spikes=(SpikeSpec(round=5, rounds=2, boost=3.0),))
+    m = ScenarioModel(cfg, 20000, seed=9)
+    pre = m.round_trace(4).num_available
+    on = m.round_trace(5).num_available
+    post = m.round_trace(7).num_available
+    assert on > 2.0 * pre
+    assert post < 1.5 * pre
+
+
+# --------------------------------------------------------------- charging
+def test_charging_window_bounds():
+    always = ScenarioModel(
+        ScenarioConfig(charging_required=True, charging_hours=24.0),
+        100, seed=5,
+    ).round_trace(3)
+    assert always.charging_ok.all()
+    never = ScenarioModel(
+        ScenarioConfig(charging_required=True, charging_hours=0.0),
+        100, seed=5,
+    ).round_trace(3)
+    assert not never.charging_ok.any()
+    assert never.num_available == 0
+
+
+# ------------------------------------------------------------------ drift
+def test_drift_starts_at_zero_and_advances_staggered():
+    cfg = ScenarioConfig(drift_period_rounds=5)
+    m = ScenarioModel(cfg, 400, seed=6, num_classes=10)
+    t0 = m.round_trace(0)
+    assert (t0.label_shift == 0).all()
+    t4 = m.round_trace(4)
+    t9 = m.round_trace(9)
+    # Stagger: at r=4 only part of the population has shifted once.
+    assert 0 < (t4.label_shift > 0).sum() < 400
+    # Shifts never decrease round over round (mod num_classes wrap needs
+    # 50 rounds at period 5 x 10 classes — not reached here).
+    assert (t9.label_shift >= t4.label_shift).all()
+    assert t9.counts()["drifted"] == int((t9.label_shift != 0).sum())
+
+
+def test_no_drift_means_no_shift():
+    tr = ScenarioModel(ScenarioConfig(), 50, seed=0).round_trace(10)
+    assert tr.label_shift is None
+    assert tr.counts()["drifted"] == 0
+
+
+# ----------------------------------------------------- trace combination
+def test_combine_with_all_on_is_identity():
+    m = ScenarioModel(ScenarioConfig(online_base=0.5), 100, seed=8)
+    tr = m.round_trace(2)
+    all_on = ClientTrace(
+        participate=np.ones(100, np.float32),
+        arrival_time=np.zeros(100, np.float32),
+        dropped=np.zeros(100, bool),
+    )
+    combined = combine_traces(all_on, tr.as_client_trace())
+    np.testing.assert_array_equal(combined.participate, tr.participate)
+    np.testing.assert_array_equal(combined.arrival_time, tr.arrival_time)
+    assert not combined.dropped.any()
+
+
+def test_combine_intersects_and_takes_later_arrival():
+    a = ClientTrace(
+        participate=np.array([1, 1, 0, 1], np.float32),
+        arrival_time=np.array([1.0, 5.0, np.inf, 2.0], np.float32),
+        dropped=np.array([0, 0, 1, 0], bool),
+    )
+    b = ClientTrace(
+        participate=np.array([1, 0, 1, 1], np.float32),
+        arrival_time=np.array([3.0, np.inf, 1.0, 1.0], np.float32),
+        dropped=np.array([0, 1, 0, 0], bool),
+    )
+    c = combine_traces(a, b)
+    np.testing.assert_array_equal(c.participate, [1, 0, 0, 1])
+    np.testing.assert_array_equal(c.arrival_time,
+                                  [3.0, np.inf, np.inf, 2.0])
+    np.testing.assert_array_equal(c.dropped, [False, True, True, False])
+    assert c.num_released == 2
+    assert c.round_duration() == 3.0
+
+
+def test_combine_rejects_mismatched_populations():
+    a = ScenarioModel(ScenarioConfig(), 10, seed=0).round_trace(0)
+    b = ScenarioModel(ScenarioConfig(), 12, seed=0).round_trace(0)
+    with pytest.raises(ValueError, match="different populations"):
+        combine_traces(a.as_client_trace(), b.as_client_trace())
+
+
+# ------------------------------------------------------------ empty fleet
+def test_empty_population():
+    m = ScenarioModel(ScenarioConfig(online_base=0.5, leave_rate=0.1),
+                      0, seed=0)
+    tr = m.round_trace(3)
+    assert tr.participate.shape == (0,)
+    assert tr.num_available == 0
+    assert tr.counts() == {"available": 0, "alive": 0, "churned": 0,
+                           "offline": 0, "drifted": 0}
+    ct = tr.as_client_trace()
+    assert ct.num_released == 0
+    assert ct.round_duration() == 0.0

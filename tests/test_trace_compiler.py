@@ -81,6 +81,57 @@ def test_surplus_schedule_truncated():
     assert tr.num_released == 100
 
 
+# --------------------------------------------- ClientTrace accessors
+def test_round_duration_and_num_released_direct():
+    """Direct unit coverage of the ClientTrace accessors (previously
+    only exercised transitively through compile_trace)."""
+    from olearning_sim_tpu.deviceflow import ClientTrace
+
+    tr = ClientTrace(
+        participate=np.array([1, 0, 1, 1], np.float32),
+        arrival_time=np.array([2.0, np.inf, 7.5, 0.0], np.float32),
+        dropped=np.array([0, 1, 0, 0], bool),
+    )
+    assert tr.num_released == 3
+    assert tr.num_dropped == 1
+    # Duration = last FINITE arrival; the never-released inf is ignored.
+    assert tr.round_duration() == 7.5
+
+
+def test_all_dropped_trace_has_zero_duration():
+    """Every scheduled message dropped: nothing released, nothing
+    arrives, duration 0 (not inf, not an empty-max crash)."""
+    tr = compile_trace(
+        flow_timing(50, [0], [50], drop={"drop_amounts": [50]}), 50, 0,
+        seed=4,
+    )
+    assert tr.num_released == 0
+    assert tr.num_dropped == 50
+    assert np.isinf(tr.arrival_time).all()
+    assert tr.round_duration() == 0.0
+
+
+def test_empty_population_trace():
+    """A zero-client population compiles to empty arrays with sane
+    accessors for every strategy shape."""
+    for strategy in (None, flow_timing(10, [0], [10])):
+        tr = compile_trace(strategy, 0, 0, seed=1)
+        assert tr.participate.shape == (0,)
+        assert tr.num_released == 0
+        assert tr.num_dropped == 0
+        assert tr.round_duration() == 0.0
+
+
+def test_empty_schedule_trace():
+    """A schedule that releases nothing leaves the whole population
+    offline (participate 0, arrival inf)."""
+    tr = compile_trace(flow_timing(0, [], []), 20, 0, seed=2)
+    assert tr.num_released == 0
+    assert np.isinf(tr.arrival_time).all()
+    assert not tr.dropped.any()
+    assert tr.round_duration() == 0.0
+
+
 def test_trace_drives_engine():
     """Full integration: churn trace -> participation mask -> round_step."""
     plan = make_mesh_plan(dp=8)
