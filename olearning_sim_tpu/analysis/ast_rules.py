@@ -79,6 +79,10 @@ WAIVERS: Dict[str, Dict[str, str]] = {
         "olearning_sim_tpu/supervisor/supervisor.py":
             "lease-expiry scans compare repo-persisted wall-clock "
             "timestamps written by the owning worker process",
+        "olearning_sim_tpu/taskmgr/pool.py":
+            "planned migration renews the cross-process wall-clock lease "
+            "and stamps the durable supervision ledger's last_resume_ts, "
+            "both compared by other processes (supervisor backoff math)",
     },
     "silent-except": {
         "olearning_sim_tpu/utils/repo.py":

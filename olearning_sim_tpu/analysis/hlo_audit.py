@@ -148,6 +148,25 @@ def load_budgets(path: Optional[str] = None) -> Dict:
         return json.load(f)
 
 
+def static_hbm_oracle(path: Optional[str] = None) -> Dict[str, Dict]:
+    """Static peak-memory facts per variant for the chip-pool scheduler's
+    admission oracle (``taskmgr/pool.CostOracle``): the blessed compiled-HLO
+    budgets reduced to ``{variant: {largest_buffer_bytes, params_bytes,
+    clients}}``. This is a *static* memory oracle — measured from the real
+    compiled program's buffer assignment, available before any execution,
+    which is exactly what admission control needs to refuse a placement
+    that would OOM a mesh instead of letting it crash."""
+    budgets = load_budgets(path)
+    return {
+        name: {
+            "largest_buffer_bytes": entry.get("largest_buffer_bytes", 0),
+            "params_bytes": entry.get("params_bytes", 0),
+            "clients": entry.get("clients", 1),
+        }
+        for name, entry in budgets.get("variants", {}).items()
+    }
+
+
 def check(artifacts_by_name: Optional[Dict[str, Dict]] = None,
           budgets: Optional[Dict] = None,
           budgets_path: Optional[str] = None) -> List[str]:

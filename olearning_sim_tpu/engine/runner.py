@@ -1282,6 +1282,36 @@ class SimulationRunner:
             self.history, **kwargs
         )
 
+    def _checkpoint_on_stop(self, last_round: int) -> None:
+        """Planned-preemption fence: a cooperative stop force-commits the
+        last completed round through the manifest commit path, so a
+        migrated task resumes from the fence round instead of replaying
+        back to the last cadence checkpoint. No-op without a checkpointer
+        or when the round is already durable; a save failure must not
+        block the stop (the resume path replays the gap bitwise anyway)."""
+        if self.checkpointer is None or last_round < 0:
+            return
+        try:
+            # Settle in-flight cadence saves first so the latest-step read
+            # is authoritative (saving an already-committed step raises).
+            self.checkpointer.wait()
+            latest = self.checkpointer.latest_round()
+            if latest is not None and latest >= last_round:
+                return
+            self.checkpointer.save(
+                last_round, self.states,
+                self._materialized_client_states(), self.history,
+            )
+            self.checkpointer.wait()
+        except Exception as e:  # noqa: BLE001 — fence best-effort
+            self.logger.warning(
+                task_id=self.task_id, system_name="engine",
+                module_name="runner",
+                message=f"fence checkpoint at round {last_round} failed "
+                        f"({e}); resume will replay from the last "
+                        f"committed step",
+            )
+
     def operator_inputs(self, operator: OperatorSpec) -> Dict[str, Any]:
         """Named upstream outputs for ``operator`` this round.
 
@@ -1902,6 +1932,7 @@ class SimulationRunner:
             # stopTask -> Ray job stop, ``task_manager.py:358-455``).
             self.stopped = True
             lp["done"] = True
+            self._checkpoint_on_stop(round_idx - 1)
             return False
         if lp["snapshotting"] and (
             self._round_snapshot is None
@@ -1969,6 +2000,10 @@ class SimulationRunner:
         if status == "stop":
             self.stopped = True
             lp["done"] = True
+            done_round = round_idx if (
+                self.history and self.history[-1].get("round") == round_idx
+            ) else round_idx - 1
+            self._checkpoint_on_stop(done_round)
             return False
         if status == "final":
             lp["done"] = True

@@ -44,6 +44,18 @@ TASK_RESUMED = "task_resumed"
 # ...or died so many consecutive times its resume budget ran out and it was
 # quarantined to FAILED instead of livelocking the supervisor.
 CRASH_LOOP = "crash_loop"
+# Chip-pool control plane (taskmgr/pool.py): a submission the scheduler
+# refused up-front — backpressure (bounded queue), oom (the static HBM
+# oracle says no mesh can hold it), or deadline (projected completion
+# blows the submit-time budget)...
+ADMISSION_REJECTED = "admission_rejected"
+# ...a running task fenced at a round boundary for a planned preemption
+# (cooperative stop + fence checkpoint through the manifest commit path)...
+TASK_PREEMPTED = "task_preempted"
+# ...and relaunched on another worker/mesh under a fresh job id, resuming
+# bitwise from the fence checkpoint (charges the same durable resume
+# budget as supervisor crash recovery).
+TASK_MIGRATED = "task_migrated"
 # Adversarial-client defense (engine/defense.py + the runner's anomaly
 # feedback loop): a participating client's Krum-style anomaly score crossed
 # the flag threshold this round...

@@ -271,10 +271,12 @@ class TaskSupervisor:
 
     # --------------------------------------------------------------- reclaim
     def _supervision(self, row: Dict[str, Any]) -> Dict[str, Any]:
-        try:
-            return json.loads(row.get("supervision") or "{}")
-        except (TypeError, ValueError):
-            return {}
+        # Shared ledger with the chip-pool scheduler's planned migrations
+        # (taskmgr/pool.py): crash resumes and migrations charge the same
+        # durable budget, so neither can livelock past it alone.
+        from olearning_sim_tpu.taskmgr.task_repo import parse_supervision
+
+        return parse_supervision(row.get("supervision"))
 
     def _backoff_elapsed(self, row: Dict[str, Any], now: float) -> bool:
         sup = self._supervision(row)

@@ -13,13 +13,25 @@ from olearning_sim_tpu.taskmgr.queue_repo import (
     RedisQueueRepo,
     SqliteQueueRepo,
 )
+from olearning_sim_tpu.taskmgr.pool import (
+    ChipPool,
+    CostOracle,
+    MeshSpec,
+    PoolScheduler,
+    TaskCost,
+)
 
 __all__ = [
+    "ChipPool",
+    "CostOracle",
     "MemoryQueueRepo",
+    "MeshSpec",
     "OperatorFlowController",
+    "PoolScheduler",
     "QueueRepo",
     "RedisQueueRepo",
     "SqliteQueueRepo",
+    "TaskCost",
     "TaskStatus",
     "calculate_conditions",
     "combine_task_status",

@@ -57,6 +57,13 @@ class LocalEngineJob:
     def stop(self) -> None:
         self._stop_event.set()
 
+    def cancel_stop(self) -> None:
+        """Withdraw a stop request the runner has not observed yet (the
+        planned-preemption fence abort). If the runner already honored
+        it, the job still lands STOPPED — callers must re-check status
+        after a short join."""
+        self._stop_event.clear()
+
     def join(self, timeout: Optional[float] = None) -> None:
         self._thread.join(timeout)
 

@@ -26,7 +26,7 @@ import collections
 import threading
 from typing import List, Optional
 
-from olearning_sim_tpu.utils.repo import connect_sqlite
+from olearning_sim_tpu.utils.repo import connect_sqlite, retry_locked
 
 
 class QueueRepo(abc.ABC):
@@ -95,33 +95,45 @@ class SqliteQueueRepo(QueueRepo):
             self._conn.commit()
 
     def push(self, payload: str) -> bool:
-        with self._lock:
-            self._conn.execute(
-                f"INSERT INTO {self._table} (payload) VALUES (?)", (payload,)
-            )
-            self._conn.commit()
-        return True
-
-    def pop(self) -> Optional[str]:
-        with self._lock:
-            # IMMEDIATE: take the write lock before reading so two processes
-            # popping the same file cannot both see (and delete) the head row.
-            self._conn.execute("BEGIN IMMEDIATE")
-            try:
-                row = self._conn.execute(
-                    f"SELECT id, payload FROM {self._table} ORDER BY id LIMIT 1"
-                ).fetchone()
-                if row is None:
-                    self._conn.commit()
-                    return None
+        # Bounded locked-retry (utils.repo.retry_locked): at submit-storm
+        # concurrency the 30 s busy_timeout itself can expire; a transient
+        # "database is locked" must not drop an intake payload.
+        def op():
+            with self._lock:
                 self._conn.execute(
-                    f"DELETE FROM {self._table} WHERE id = ?", (row[0],)
+                    f"INSERT INTO {self._table} (payload) VALUES (?)",
+                    (payload,),
                 )
                 self._conn.commit()
-                return row[1]
-            except Exception:
-                self._conn.rollback()
-                raise
+            return True
+
+        return retry_locked(op)
+
+    def pop(self) -> Optional[str]:
+        def op():
+            with self._lock:
+                # IMMEDIATE: take the write lock before reading so two
+                # processes popping the same file cannot both see (and
+                # delete) the head row.
+                self._conn.execute("BEGIN IMMEDIATE")
+                try:
+                    row = self._conn.execute(
+                        f"SELECT id, payload FROM {self._table} "
+                        f"ORDER BY id LIMIT 1"
+                    ).fetchone()
+                    if row is None:
+                        self._conn.commit()
+                        return None
+                    self._conn.execute(
+                        f"DELETE FROM {self._table} WHERE id = ?", (row[0],)
+                    )
+                    self._conn.commit()
+                    return row[1]
+                except Exception:
+                    self._conn.rollback()
+                    raise
+
+        return retry_locked(op)
 
     def peek_all(self) -> List[str]:
         with self._lock:

@@ -20,6 +20,9 @@ from olearning_sim_tpu.proto import taskservice_pb2 as pb
 class ScheduleResult:
     task: pb.TaskConfig
     task_request: Dict[str, Any]
+    # Chip-pool strategies also choose WHERE: the pool worker/mesh the
+    # launch should land on (None for pool-less strategies).
+    worker: Optional[str] = None
 
 
 def get_task_request_resource(task: pb.TaskConfig) -> Dict[str, Any]:
@@ -102,10 +105,26 @@ class DefaultStrategy(SchedulerStrategy):
         return ScheduleResult(task=waiting[idx]["task"], task_request=waiting[idx]["task_request"])
 
 
+class FifoPopStrategy(SchedulerStrategy):
+    """Strict FIFO pop — the reference's durable-queue semantics (and this
+    repo's pre-chip-pool behavior): the HEAD of the queue launches when it
+    fits and nothing overtakes it. The scheduler bench's baseline; the
+    cost-model pool scheduler (taskmgr/pool.py) is measured against it."""
+
+    def schedule_next_task(self, task_queue, available_resources):
+        if not task_queue:
+            return None
+        task = task_queue[0]
+        request = get_task_request_resource(task)
+        if not check_resource_availability(request, available_resources):
+            return None  # head-of-line blocking: wait for room
+        return ScheduleResult(task=task, task_request=request)
+
+
 class StrategyFactory:
     """Reference ``StrategyFactory`` (``scheduler_strategy.py:190-193``)."""
 
-    _registry = {"default": DefaultStrategy}
+    _registry = {"default": DefaultStrategy, "fifo": FifoPopStrategy}
 
     @classmethod
     def register(cls, name: str, strategy_cls) -> None:

@@ -102,6 +102,9 @@ class TaskManager:
         lease_ttl: float = 60.0,
         heartbeat_interval: Optional[float] = None,
         supervise_orphans: bool = False,
+        pool=None,
+        rebalance_interval: float = 2.0,
+        adopt_stranded_after: Optional[float] = None,
     ):
         """``runner_factory(task_config, task_repo, deviceflow, stop_event)``
         builds the engine runner for a scheduled task; defaults to the
@@ -125,7 +128,26 @@ class TaskManager:
         self._phone_client = phone_client
         self._perf = perf
         self._task_queue = TaskQueue()
-        self._strategy = StrategyFactory.create_strategy(scheduler_strategy)
+        # Chip-pool control plane (taskmgr/pool.py): when a PoolScheduler
+        # is supplied it IS the strategy, and additionally gates submission
+        # (admission control) and drives planned preemption/migration from
+        # the rebalance daemon.
+        self._pool = pool
+        self._rebalance_interval = rebalance_interval
+        # Multi-manager rescue: a QUEUED row sitting in a DEAD manager's
+        # in-memory queue is invisible to everyone else (boot recovery
+        # only runs at boot). With adopt_stranded_after=S, the schedule
+        # daemon periodically re-adopts QUEUED rows older than S seconds
+        # that are not in the local queue; the pre-launch QUEUED-status
+        # check + lease CAS make duplicate adoption race-safe (exactly one
+        # launch wins). None (default) keeps single-manager behavior.
+        self._adopt_stranded_after = adopt_stranded_after
+        self._last_adopt_scan = 0.0
+        if pool is not None:
+            pool.bind(self)
+            self._strategy = pool
+        else:
+            self._strategy = StrategyFactory.create_strategy(scheduler_strategy)
         self._schedule_interval = schedule_interval
         self._release_interval = release_interval
         self._interrupt_interval = interrupt_interval
@@ -168,6 +190,14 @@ class TaskManager:
         # was live): local resources were released at fencing time and the
         # row now belongs to the reclaimer — our daemons must not write it.
         self._fenced: set = set()
+        # Tasks mid-migration (pool scheduler fence window): their job is
+        # deliberately stopped between fence and relaunch, and the release
+        # loop must not finalize that transient as STOPPED.
+        self._migrating: set = set()
+        # task_id -> monotonic submit-accept time: queue-wait measurement
+        # for the ols_taskmgr_task_wait_seconds histogram (in_queue_time
+        # has only 1 s resolution).
+        self._queue_entered: Dict[str, float] = {}
         # (task_id, data_name) -> staged device-shard path (hybrid split)
         self._device_paths: dict = {}
         self._lock = threading.RLock()
@@ -281,6 +311,21 @@ class TaskManager:
             if task_id in self._task_queue:
                 return False
             repo = self._task_repo
+            if self._pool is not None:
+                decision = self._pool.admit(tc, len(self._task_queue))
+                if not decision.ok:
+                    # Terminal by policy: an admission rejection fails the
+                    # row loudly (admission_rejected event + metric already
+                    # recorded by the pool) — the submitter resubmits as a
+                    # new task once pressure clears. Never a silent queue,
+                    # never a placement that OOMs a mesh at launch.
+                    repo.set_item_value(task_id, "task_status",
+                                        TaskStatus.FAILED.name)
+                    repo.set_item_value(
+                        task_id, "task_finished_time",
+                        time.strftime("%Y-%m-%d %H:%M:%S"),
+                    )
+                    return False
             repo.set_item_value(task_id, "task_params", json.dumps(taskconfig2json(tc)))
             repo.set_item_value(
                 task_id, "total_simulation", json.dumps(_total_simulation_entry(tc))
@@ -289,6 +334,7 @@ class TaskManager:
             repo.set_item_value(task_id, "in_queue_time", time.strftime("%Y-%m-%d %H:%M:%S"))
             repo.set_item_value(task_id, "resource_occupied", "0")
             self._task_queue.add(tc)
+            self._queue_entered[task_id] = time.monotonic()
             self._update_queue_gauge()
             return True
 
@@ -306,6 +352,9 @@ class TaskManager:
         with self._lock:
             if task_id in self._task_queue:
                 self._task_queue.delete(task_id)
+                self._queue_entered.pop(task_id, None)
+                if self._pool is not None:
+                    self._pool.abort_launch(task_id)
                 self._update_queue_gauge()
                 self._task_repo.set_item_value(task_id, "task_status", TaskStatus.STOPPED.name)
                 return True
@@ -557,10 +606,61 @@ class TaskManager:
                             "(validation / duplicate / missing UNDONE row)",
                 )
 
+    def adopt_stranded_once(self, now: Optional[float] = None) -> int:
+        """Re-queue QUEUED rows stranded by a dead sibling manager (see
+        ``adopt_stranded_after``). Returns how many were adopted."""
+        if self._adopt_stranded_after is None:
+            return 0
+        # lint: allow-wall-clock — in_queue_time is a wall-clock timestamp
+        # persisted by (possibly dead) sibling processes.
+        now = time.time() if now is None else now
+        if now - self._last_adopt_scan < self._adopt_stranded_after:
+            return 0
+        self._last_adopt_scan = now
+        adopted = 0
+        for row in self._task_repo.query_all():
+            if row.get("task_status") != TaskStatus.QUEUED.name:
+                continue
+            task_id = row.get("task_id", "")
+            if not task_id or task_id in self._task_queue:
+                continue
+            in_queue = row.get("in_queue_time")
+            if not in_queue:
+                continue
+            try:
+                queued_at = time.mktime(
+                    time.strptime(in_queue, "%Y-%m-%d %H:%M:%S"))
+            except ValueError:
+                continue
+            if now - queued_at < self._adopt_stranded_after:
+                continue
+            try:
+                tc = json2taskconfig(row["task_params"])
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(
+                    task_id=task_id, system_name="TaskMgr",
+                    module_name="adopt",
+                    message=f"stranded QUEUED row undecodable: {e}",
+                )
+                continue
+            with self._lock:
+                if self._task_queue.add(tc):
+                    adopted += 1
+                    self.logger.info(
+                        task_id=task_id, system_name="TaskMgr",
+                        module_name="adopt",
+                        message="adopted stranded QUEUED task from a dead "
+                                "sibling manager's queue",
+                    )
+        if adopted:
+            self._update_queue_gauge()
+        return adopted
+
     def schedule_once(self) -> Optional[str]:
         """One scheduler iteration (reference ``run`` thread body,
         ``task_manager.py:1053-1069``); returns the launched task id."""
         self.drain_intake_once()
+        self.adopt_stranded_once()
         with self._lock:
             queue = self._task_queue.get_task_queue()
         if not queue:
@@ -586,9 +686,30 @@ class TaskManager:
     def _submit_scheduled(self, result: ScheduleResult) -> None:
         """Freeze -> register deviceflow -> launch (reference
         ``threading_submit_task``, ``task_manager.py:917-1051``)."""
+        launched = False
+        try:
+            launched = bool(self._submit_scheduled_inner(result))
+        finally:
+            if not launched:
+                # The task left the queue on every failure path too —
+                # drop its wait-clock entry (leaks otherwise) and the
+                # pool's pending placement.
+                self._queue_entered.pop(result.task.taskID.taskID, None)
+                if self._pool is not None:
+                    self._pool.abort_launch(result.task.taskID.taskID)
+
+    def _submit_scheduled_inner(self, result: ScheduleResult) -> bool:
         tc = result.task
         task_id = tc.taskID.taskID
         repo = self._task_repo
+        # Exactly-once across managers: another manager sharing this task
+        # table may have launched (or finished) the task since it entered
+        # OUR in-memory queue (boot recovery re-queues every QUEUED row).
+        # Launch only a task that is still QUEUED; anything else belongs
+        # to whoever moved it on.
+        stored = repo.get_item_value(task_id, "task_status")
+        if stored not in (TaskStatus.QUEUED.name, None):
+            return False
         if any(td.allocation.optimization for td in tc.target.targetData):
             # Hybrid ILP allocation before launch (reference
             # HybridOptimizer.fix_data_parameters, utils_runner.py:29-51).
@@ -600,7 +721,7 @@ class TaskManager:
                 self.logger.error(task_id=task_id, system_name="TaskMgr",
                                   module_name="hybrid", message=f"allocation failed: {e}")
                 repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
-                return
+                return False
         try:
             self._stage_hybrid_data(tc)
         except Exception as e:  # noqa: BLE001
@@ -608,9 +729,9 @@ class TaskManager:
                               module_name="hybrid",
                               message=f"hybrid data split failed: {e}")
             repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
-            return
+            return False
         if repo.get_item_value(task_id, "task_status") == TaskStatus.STOPPED.name:
-            return  # stopped while being scheduled
+            return False  # stopped while being scheduled
         # Persist the (possibly allocator-mutated) config and the logical
         # half's target BEFORE launch, so status fusion never sees an
         # occupied task with a vacuously-absent logical half.
@@ -634,7 +755,7 @@ class TaskManager:
                 task_id, tc.userID, req["cpu"], req["mem"]
             ):
                 repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
-                return
+                return False
             # Freeze the phone share too (reference 2-phase freeze,
             # task_scheduler.py:71-174) so concurrent hybrid tasks cannot
             # oversubscribe the farm behind the scheduler's back.
@@ -646,7 +767,7 @@ class TaskManager:
                 ):
                     self._resource_manager.release_resource(task_id)
                     repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
-                    return
+                    return False
         if self._deviceflow is not None:
             uses_flow = any(
                 op.operationBehaviorController.useController
@@ -661,27 +782,28 @@ class TaskManager:
             if self._resource_manager is not None:
                 self._resource_manager.release_resource(task_id)
             repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
-            return
+            return False
         # Ownership BEFORE launch and BEFORE the RUNNING write: a RUNNING
         # row with no lease reads as expired, so writing status first would
         # open a window where a supervisor reclaims (and relaunches) the
         # task while our job is coming up. A failed claim means another
         # process holds a live lease on this task — refuse the double
-        # launch outright.
+        # launch outright and leave the row to its owner (multi-manager
+        # deployments share one task table; stamping FAILED here would
+        # stomp the owner's live run).
         if not self._task_repo.claim_lease(task_id, self.owner_id,
                                            self.lease_ttl):
             self.logger.error(
                 task_id=task_id, system_name="TaskMgr", module_name="submit",
                 message="another process holds a live lease on this task; "
-                        "refusing to double-launch",
+                        "refusing to double-launch (its owner drives it)",
             )
             if self._phone_client is not None and \
                     repo.get_item_value(task_id, "device_target"):
                 self._phone_client.stop_device(task_id)
             if self._resource_manager is not None:
                 self._resource_manager.release_resource(task_id)
-            repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
-            return
+            return False
         try:
             from olearning_sim_tpu.resilience import faults
 
@@ -724,7 +846,7 @@ class TaskManager:
                 self._resource_manager.release_resource(task_id)
             repo.set_item_value(task_id, "task_status", TaskStatus.FAILED.name)
             self._task_repo.release_lease(task_id, self.owner_id)
-            return
+            return False
         repo.set_item_value(task_id, "job_id", job_id)
         repo.set_item_value(task_id, "task_status", TaskStatus.RUNNING.name)
         repo.set_item_value(task_id, "resource_occupied", "1")
@@ -732,6 +854,18 @@ class TaskManager:
         # The heartbeat daemon renews the lease claimed above while the job
         # lives; if this process dies, expiry is the supervisor's signal.
         self._own_jobs[task_id] = job_id
+        entered = self._queue_entered.pop(task_id, None)
+        if entered is not None:
+            from olearning_sim_tpu.telemetry import instrument
+
+            instrument("ols_taskmgr_task_wait_seconds").observe(
+                time.monotonic() - entered
+            )
+        if self._pool is not None:
+            # Consume the pending placement: the worker's HBM share is
+            # charged and the row's worker_id records where it landed.
+            self._pool.on_launched(task_id)
+        return True
 
     # ------------------------------------------------------- release/interrupt
     def release_once(self) -> None:
@@ -745,6 +879,10 @@ class TaskManager:
             if task_id in self._fenced:
                 # Another process reclaimed this task (heartbeat fencing):
                 # the row — including its final status — is theirs to write.
+                continue
+            if task_id in self._migrating:
+                # Planned preemption in flight: the stopped job is a fence,
+                # not a terminal state — the pool scheduler relaunches it.
                 continue
             job_id = row.get("job_id")
             if self._supervise_orphans and job_id and \
@@ -776,6 +914,8 @@ class TaskManager:
             self._task_repo.release_lease(task_id, self.owner_id)
             self._own_jobs.pop(task_id, None)
             self._cleanup_hybrid_staging(task_id)
+            if self._pool is not None:
+                self._pool.on_finished(task_id)
 
     def heartbeat_once(self, now: Optional[float] = None) -> None:
         """Renew the lease of every task this process owns whose engine job
@@ -830,6 +970,8 @@ class TaskManager:
                 if self._resource_manager is not None:
                     self._resource_manager.release_resource(task_id)
                 self._cleanup_hybrid_staging(task_id)
+                if self._pool is not None:
+                    self._pool.on_finished(task_id)
                 continue
             self.logger.error(
                 task_id=task_id, system_name="TaskMgr",
@@ -846,6 +988,8 @@ class TaskManager:
             if self._resource_manager is not None:
                 self._resource_manager.release_resource(task_id)
             self._cleanup_hybrid_staging(task_id)
+            if self._pool is not None:
+                self._pool.on_finished(task_id)
 
     def interrupt_once(self, now: Optional[float] = None) -> None:
         """Watchdog (reference ``interruptTask``, ``task_manager.py:1150-1200``):
@@ -871,12 +1015,16 @@ class TaskManager:
     def start(self) -> None:
         """Reference daemon threads (``task_manager.py:79-84``)."""
         self._stop.clear()
-        for fn, interval, name in (
+        daemons = [
             (self.schedule_once, self._schedule_interval, "taskmgr-schedule"),
             (self.release_once, self._release_interval, "taskmgr-release"),
             (self.interrupt_once, self._interrupt_interval, "taskmgr-interrupt"),
             (self.heartbeat_once, self._heartbeat_interval, "taskmgr-heartbeat"),
-        ):
+        ]
+        if self._pool is not None:
+            daemons.append((self._pool.rebalance_once,
+                            self._rebalance_interval, "taskmgr-rebalance"))
+        for fn, interval, name in daemons:
             t = threading.Thread(
                 target=self._loop, args=(fn, interval), name=name, daemon=True
             )

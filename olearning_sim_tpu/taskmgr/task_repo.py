@@ -8,10 +8,24 @@ interface over a pluggable TableRepo backend.
 
 from __future__ import annotations
 
+import json
 import time
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from olearning_sim_tpu.utils.repo import MemoryTableRepo, SqliteTableRepo, TableRepo
+
+
+def parse_supervision(value: Any) -> Dict[str, Any]:
+    """Decode a row's durable ``supervision`` blob ({"resumes": n,
+    "last_resume_ts": t}). THE shared resume-budget ledger: supervisor
+    crash recovery and the chip-pool scheduler's planned migrations both
+    read and charge it, so a migration storm and a crash loop drain one
+    budget and degrade to FAIL_TASK together."""
+    try:
+        return json.loads(value or "{}")
+    except (TypeError, ValueError):
+        return {}
+
 
 def make_owner_id(prefix: str = "") -> str:
     """Lease identity: host:pid plus a random token, so two owners in one
@@ -41,6 +55,7 @@ TASK_COLUMNS = [
     "device_operator",
     "device_result",
     "job_id",
+    "worker_id",          # chip-pool placement: which pool worker/mesh runs it
     "resilience",         # JSON digest of resilience counters/events (runner)
     "resource_occupied",
     "owner_id",           # lease: process owning the task's engine job

@@ -278,6 +278,26 @@ CATALOG = {
     "ols_taskmgr_queue_depth": (
         GAUGE, "Tasks waiting in the scheduler queue", (),
     ),
+    "ols_taskmgr_admission_rejected_total": (
+        COUNTER,
+        "Submissions refused by chip-pool admission control by reason "
+        "(backpressure / oom / deadline); rejected tasks are failed "
+        "loudly, never queued silently (taskmgr/pool.py)",
+        ("reason",),
+    ),
+    "ols_taskmgr_task_wait_seconds": (
+        HISTOGRAM,
+        "Queue wait per launched task: submit accepted -> engine job "
+        "launched (the p95 of this is the scheduler bench's figure of "
+        "merit vs FIFO)",
+        (), _PHASE_BUCKETS,
+    ),
+    "ols_taskmgr_pool_utilization_ratio": (
+        GAUGE,
+        "Fraction of a pool worker's peak-HBM capacity consumed by "
+        "current placements (chip-pool scheduler ledger)",
+        ("worker",),
+    ),
     # --------------------------------------------------------- supervisor
     "ols_supervisor_resumes_total": (
         COUNTER,

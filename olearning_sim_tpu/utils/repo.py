@@ -28,6 +28,66 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 
+# "database is locked/busy" flavors sqlite raises when busy_timeout runs
+# out under write contention. WAL + busy_timeout absorb most of it, but at
+# hundreds of concurrent writers (submit-storm scale) the timeout itself
+# can expire — those writes go through a bounded RetryPolicy instead of
+# surfacing a transient as a hard failure.
+_LOCKED_MARKERS = ("database is locked", "database is busy",
+                   "database table is locked")
+_LOCKED = object()  # sentinel: the attempt hit a locked error
+_LOCKED_POLICY = None
+
+
+def _locked_error(e: BaseException) -> bool:
+    return isinstance(e, sqlite3.OperationalError) and any(
+        m in str(e).lower() for m in _LOCKED_MARKERS
+    )
+
+
+def _locked_policy():
+    global _LOCKED_POLICY
+    if _LOCKED_POLICY is None:
+        # Lazy import: resilience pulls in telemetry/numpy; the repo layer
+        # must stay importable without them at module-import time.
+        from olearning_sim_tpu.resilience.retry import RetryPolicy
+
+        # retry_on=(): raised exceptions are NEVER absorbed here — only
+        # the locked sentinel routed through the bool contract retries, so
+        # a real error (missing table, corrupt file) surfaces immediately.
+        _LOCKED_POLICY = RetryPolicy(max_attempts=6, base_delay=0.01,
+                                     max_delay=0.25, jitter=0.25,
+                                     retry_on=())
+    return _LOCKED_POLICY
+
+
+def retry_locked(fn, policy=None, point: str = "repo.sqlite_locked"):
+    """Run ``fn`` under a bounded retry on sqlite lock contention.
+
+    Only ``OperationalError: database is locked/busy`` is retried (and
+    recorded as ``retry`` resilience events under ``point``); every other
+    error propagates immediately. When the budget runs out the last locked
+    error is re-raised — the caller's normal sqlite3.Error handling
+    applies, so contracts (False/None returns) survive unchanged.
+    """
+    pol = policy if policy is not None else _locked_policy()
+    last: List[BaseException] = []
+
+    def attempt():
+        try:
+            return fn()
+        except sqlite3.OperationalError as e:
+            if not _locked_error(e):
+                raise
+            last.append(e)
+            return _LOCKED
+
+    result = pol.call(attempt, retry_if=lambda r: r is _LOCKED, point=point)
+    if result is _LOCKED:
+        raise last[-1]
+    return result
+
+
 def connect_sqlite(path: str, *, busy_timeout_s: float = 30.0,
                    synchronous: str = "NORMAL") -> sqlite3.Connection:
     """The one way the platform opens a sqlite control-plane DB.
@@ -309,10 +369,14 @@ class SqliteTableRepo(TableRepo):
                 f"UPDATE {self.table} SET {self._col(item)} = ? "
                 f"WHERE {self._col(identify_name)} = ?"
             )
-            with self._lock:
-                cur = self._conn.execute(sql, (value, identify_value))
-                self._conn.commit()
-            return cur.rowcount > 0
+
+            def op():
+                with self._lock:
+                    cur = self._conn.execute(sql, (value, identify_value))
+                    self._conn.commit()
+                return cur.rowcount > 0
+
+            return retry_locked(op)
         except sqlite3.Error:
             return False
 
@@ -369,10 +433,17 @@ class SqliteTableRepo(TableRepo):
                       owner_value]
             if steal:
                 params.append(float(now))
-            with self._lock:
-                cur = self._conn.execute(sql, params)
-                self._conn.commit()
-            return cur.rowcount > 0
+
+            # The lease CAS under storm concurrency: a locked error here is
+            # NOT an arbitration loss (the UPDATE never ran) — retry it
+            # bounded instead of reading it as "claim refused".
+            def op():
+                with self._lock:
+                    cur = self._conn.execute(sql, params)
+                    self._conn.commit()
+                return cur.rowcount > 0
+
+            return retry_locked(op)
         except sqlite3.Error:
             return False
 
@@ -383,10 +454,14 @@ class SqliteTableRepo(TableRepo):
                    f"{self._col(expires_item)} = '' WHERE "
                    f"{self._col(identify_name)} = ? AND "
                    f"{self._col(owner_item)} = ?")
-            with self._lock:
-                cur = self._conn.execute(sql, (identify_value, owner_value))
-                self._conn.commit()
-            return cur.rowcount > 0
+
+            def op():
+                with self._lock:
+                    cur = self._conn.execute(sql, (identify_value, owner_value))
+                    self._conn.commit()
+                return cur.rowcount > 0
+
+            return retry_locked(op)
         except sqlite3.Error:
             return False
 

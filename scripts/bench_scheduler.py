@@ -1,0 +1,649 @@
+#!/usr/bin/env python
+"""Submit-storm chaos harness + scheduler bench (``BENCH_scheduler.json``).
+
+Drives hundreds of concurrent mixed-family submissions (sync / async /
+streamed stub workloads) against ONE shared sqlite task table served by
+several ``TaskManager`` "workers" (each its own connection, launcher, and
+lease identity), while the seeded ``FaultInjector``:
+
+- **kills workers** — a ``runner.round_begin`` spec with ``error="preempt"``
+  takes down the whole hosting manager (daemons stopped, nothing released:
+  a process death). Its RUNNING rows lose their heartbeat, the leases
+  expire, and standalone ``TaskSupervisor``s reclaim + resume them; its
+  QUEUED rows are re-adopted by a replacement manager's boot recovery.
+- **delays compiles** — an ``error="false"`` spec whose payload stretches
+  the stub's first-round "compile".
+- **flakes rounds** — low-probability ``error="io"`` specs the stub absorbs
+  as transient retries.
+
+Invariants the harness (and ``tests/test_scheduler_storm.py``) asserts:
+every submitted task reaches a terminal state (SUCCEEDED, or FAILED by an
+explicit policy: admission rejection, crash-loop budget), none is lost,
+and no task ever has two live runners (the exactly-once ledger).
+
+Bench mode (``python scripts/bench_scheduler.py``) runs the same storm
+twice — FIFO (DefaultStrategy + cpu-ledger capacity) vs the chip-pool
+cost-model scheduler (same total capacity expressed as mesh HBM) — and
+banks aggregate device-rounds/sec + p50/p95 task wait per mode. CPU
+entries are degraded measurements.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from olearning_sim_tpu.resilience import faults  # noqa: E402
+from olearning_sim_tpu.resilience.events import (  # noqa: E402
+    ADMISSION_REJECTED,
+    TASK_MIGRATED,
+    TASK_RESUMED,
+    ResilienceLog,
+)
+from olearning_sim_tpu.resilience.faults import (  # noqa: E402
+    FaultPlan,
+    FaultSpec,
+    HostPreemption,
+)
+from olearning_sim_tpu.supervisor import TaskSupervisor  # noqa: E402
+from olearning_sim_tpu.taskmgr.pool import (  # noqa: E402
+    ChipPool,
+    CostOracle,
+    MeshSpec,
+    PoolScheduler,
+)
+from olearning_sim_tpu.taskmgr.status import TaskStatus  # noqa: E402
+from olearning_sim_tpu.taskmgr.task_manager import TaskManager  # noqa: E402
+from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo  # noqa: E402
+
+GIB = 1 << 30
+
+# Storm families: a production-ish mix. ``round_s`` is simulated work per
+# round (wall sleep), ``clients`` weights device-rounds/sec, ``hbm_gb``
+# doubles as the FIFO cpu-ledger demand so both modes see IDENTICAL
+# capacity and differ only in ordering/admission.
+FAMILIES: Dict[str, Dict[str, Any]] = {
+    "sync_small": {"rounds": 5, "round_s": 0.004, "clients": 64,
+                   "hbm_gb": 2.0, "priority": 5, "weight": 5},
+    "async_medium": {"rounds": 8, "round_s": 0.006, "clients": 256,
+                     "hbm_gb": 4.0, "priority": 5, "weight": 4},
+    "stream_large": {"rounds": 6, "round_s": 0.15, "clients": 4096,
+                     "hbm_gb": 8.0, "priority": 1, "weight": 2},
+    "deadline_interactive": {"rounds": 3, "round_s": 0.003, "clients": 32,
+                             "hbm_gb": 2.0, "priority": 9, "weight": 2,
+                             "deadline_s": 120.0},
+}
+# Admission-bait: estimated peak HBM larger than any mesh — the pool
+# scheduler must reject it up-front (reason=oom) instead of launching a
+# crash. Excluded from FIFO runs (FIFO has no admission and would strand
+# it QUEUED forever, failing the none-lost invariant by design).
+OOM_FAMILY = {"rounds": 2, "round_s": 0.001, "clients": 8,
+              "hbm_gb": 64.0, "priority": 5}
+
+
+def make_storm_task_json(task_id: str, family: str,
+                         spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Minimal valid task JSON for a storm stub task. The engine params
+    carry the family's cost-model hints in the ``scheduling`` block (the
+    telemetry-fed path is exercised separately via CostOracle feeds)."""
+    engine_params = {
+        "model": {"name": "storm_stub"},
+        "algorithm": {"name": family},
+        "scheduling": {
+            "family": family,
+            "round_time_s": spec["round_s"],
+            "compile_s": 0.01,
+            "peak_hbm_bytes": spec["hbm_gb"] * GIB,
+            **({"deadline_s": spec["deadline_s"]}
+               if "deadline_s" in spec else {}),
+        },
+        "storm": {"rounds": spec["rounds"], "round_s": spec["round_s"],
+                  "clients": spec["clients"]},
+    }
+    cond = {"logical_simulation": {"strategy": "", "wait_interval": 0,
+                                   "total_timeout": 0},
+            "device_simulation": {"strategy": "", "wait_interval": 0,
+                                  "total_timeout": 0}}
+    return {
+        "user_id": "storm",
+        "task_id": task_id,
+        "target": {
+            "priority": int(spec.get("priority", 0)),
+            "data": [{
+                "name": "data_0",
+                "data_path": "",
+                "data_split_type": False,
+                "data_transfer_type": "FILE",
+                "task_type": "classification",
+                "total_simulation": {"devices": ["high"],
+                                     "nums": [spec["clients"]],
+                                     "dynamic_nums": [0]},
+                "allocation": {
+                    "optimization": False,
+                    "logical_simulation": [spec["clients"]],
+                    "device_simulation": [0],
+                    "running_response": {"devices": [], "nums": []},
+                },
+            }],
+        },
+        "operatorflow": {
+            "flow_setting": {"round": spec["rounds"], "start": cond,
+                             "stop": cond},
+            "operators": [{
+                "name": "train",
+                "operation_behavior_controller": {
+                    "use_gradient_house": False,
+                    "strategy_gradient_house": "", "outbound_service": "",
+                },
+                "input": [],
+                "use_data": True,
+                "model": {"use_model": False, "model_for_train": True,
+                          "model_transfer_type": "FILE", "model_path": "",
+                          "model_update_style": ""},
+                "logical_simulation": {
+                    "operator_transfer_type": "FILE",
+                    "operator_code_path": "builtin:train",
+                    "operator_entry_file": "",
+                    "operator_params": json.dumps(engine_params),
+                },
+                "device_simulation": {"operator_transfer_type": "FILE",
+                                      "operator_code_path": "",
+                                      "operator_entry_file": "",
+                                      "operator_params": ""},
+            }],
+        },
+        "logical_simulation": {
+            "computation_unit": {"devices": ["high"],
+                                 "setting": [{"num_cpus": 1}]},
+            "resource_request": [{"name": "data_0", "devices": ["high"],
+                                  # FIFO capacity currency: hbm_gb units.
+                                  "num_request": [
+                                      max(1, int(spec["hbm_gb"]))]}],
+        },
+        "device_simulation": {"resource_request": [
+            {"name": "data_0", "devices": [], "num_request": []}]},
+    }
+
+
+class StormLedger:
+    """Exactly-once + throughput accounting shared by every stub runner."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.in_flight: Dict[str, int] = {}
+        self.double_runs: List[str] = []
+        self.first_start: Dict[str, float] = {}
+        self.submit_t: Dict[str, float] = {}
+        self.runs: Dict[str, int] = {}
+        self.device_rounds = 0
+        self.io_faults = 0
+        self.kills = 0
+
+    @contextlib.contextmanager
+    def track(self, task_id: str):
+        with self.lock:
+            n = self.in_flight.get(task_id, 0) + 1
+            self.in_flight[task_id] = n
+            if n > 1:
+                self.double_runs.append(task_id)
+            self.first_start.setdefault(task_id, time.monotonic())
+            self.runs[task_id] = self.runs.get(task_id, 0) + 1
+        try:
+            yield
+        finally:
+            with self.lock:
+                self.in_flight[task_id] -= 1
+
+    def record_round(self, clients: int) -> None:
+        with self.lock:
+            self.device_rounds += clients
+
+    def waits(self) -> List[float]:
+        with self.lock:
+            return [self.first_start[t] - s
+                    for t, s in self.submit_t.items()
+                    if t in self.first_start]
+
+
+class StormWorker:
+    """One 'host': a TaskManager with its own sqlite connection, launcher
+    and lease identity. ``die()`` models process death — daemons stopped,
+    nothing released, leases left to expire."""
+
+    def __init__(self, name: str, db_path: str, mode: str, ledger: StormLedger,
+                 lease_ttl: float = 1.0, max_queue: int = 512,
+                 meshes_per_worker: int = 2, mesh_hbm_gb: float = 8.0,
+                 log: Optional[ResilienceLog] = None):
+        self.name = name
+        self.ledger = ledger
+        self.dead = threading.Event()
+        repo = TaskTableRepo(sqlite_path=db_path)
+        kwargs: Dict[str, Any] = {}
+        if mode == "pool":
+            pool = ChipPool([
+                MeshSpec(f"{name}/mesh{i}", hbm_bytes=mesh_hbm_gb * GIB)
+                for i in range(meshes_per_worker)
+            ])
+            kwargs["pool"] = PoolScheduler(pool, CostOracle(),
+                                           max_queue=max_queue, log=log)
+            kwargs["rebalance_interval"] = 0.1
+            resource_manager = None
+        else:
+            from olearning_sim_tpu.resourcemgr import (
+                ResourceManager,
+                TpuTopology,
+            )
+
+            total = meshes_per_worker * mesh_hbm_gb
+            resource_manager = ResourceManager(topology=TpuTopology(
+                num_chips=meshes_per_worker, num_cores=8, platform="cpu",
+                device_kinds=["cpu"], cpu=total, mem=1e9,
+            ))
+        self.manager = TaskManager(
+            task_repo=repo,
+            resource_manager=resource_manager,
+            scheduler_strategy="fifo" if mode == "fifo" else "default",
+            runner_factory=self._runner_factory,
+            schedule_interval=0.01,
+            release_interval=0.03,
+            interrupt_interval=3600,
+            lease_ttl=lease_ttl,
+            supervise_orphans=True,
+            adopt_stranded_after=2.0,
+            **kwargs,
+        )
+
+    def _runner_factory(self, tc, stop_event):
+        return StormRunner(tc, stop_event, self, self.ledger,
+                           self.manager._task_repo)
+
+    def start(self) -> None:
+        self.manager.start()
+
+    def die(self) -> None:
+        """Process death: stop every daemon, release nothing."""
+        if self.dead.is_set():
+            return
+        self.dead.set()
+        self.ledger.kills += 1
+        self.manager.stop()
+
+    def stop(self) -> None:
+        self.manager.stop()
+
+
+class StormRunner:
+    """Stub engine job: N rounds of simulated work with fault-injection
+    consultation at the documented ``runner.round_begin`` point, writing
+    the logical progress rows status fusion needs for SUCCEEDED."""
+
+    def __init__(self, tc, stop_event, worker, ledger: StormLedger, repo,
+                 worker_name: Optional[str] = None):
+        self.tc = tc
+        self.stop_event = stop_event
+        self.worker = worker
+        self.worker_name = worker_name if worker_name is not None else (
+            worker.name if worker is not None else "supervisor")
+        self.ledger = ledger
+        self.repo = repo
+        self.stopped = False
+        params = json.loads(
+            tc.operatorFlow.operator[0].logicalSimulationOperatorInfo
+            .operatorParams
+        )
+        self.storm = params.get("storm", {})
+
+    def run(self) -> None:
+        task_id = self.tc.taskID.taskID
+        rounds = int(self.storm.get("rounds", 1))
+        round_s = float(self.storm.get("round_s", 0.001))
+        clients = int(self.storm.get("clients", 1))
+        with self.ledger.track(task_id):
+            for r in range(rounds):
+                if self.worker is not None and self.worker.dead.is_set():
+                    raise faults.FaultError(
+                        f"worker {self.worker_name} is dead")
+                if self.stop_event is not None and self.stop_event.is_set():
+                    self.stopped = True
+                    return
+                spec = faults.fire(
+                    "runner.round_begin",
+                    context=f"{self.worker_name}:{task_id}",
+                    round_idx=r, task_id=task_id,
+                )
+                if spec is not None:
+                    if spec.error == "preempt":
+                        # The injected preemption takes down the host.
+                        if self.worker is not None:
+                            self.worker.die()
+                        raise HostPreemption(
+                            f"injected kill of {self.worker_name}")
+                    if spec.error == "false":
+                        # Compile delay: stretch this round's dispatch.
+                        time.sleep(float(
+                            (spec.payload or {}).get("delay_s", 0.01)))
+                    else:
+                        # Transient io flake: absorbed like the real
+                        # runner's retry policy would.
+                        with self.ledger.lock:
+                            self.ledger.io_faults += 1
+                time.sleep(round_s)
+                self.ledger.record_round(clients)
+        # Final logical progress: what the status calculus fuses into
+        # SUCCEEDED (success_num reaches nums for every class).
+        nums = [clients]
+        self.repo.set_item_value(task_id, "logical_round", rounds)
+        self.repo.set_item_value(task_id, "logical_operator", "train")
+        self.repo.set_item_value(task_id, "logical_result", json.dumps({
+            "logical_result": [{
+                "name": "data_0",
+                "simulation_target": {"devices": ["high"],
+                                      "success_num": nums,
+                                      "failed_num": [0]},
+            }],
+        }))
+
+
+def build_fault_plan(seed: int, kill_workers: List[str],
+                     compile_delay_s: float = 0.02,
+                     io_probability: float = 0.02) -> FaultPlan:
+    """Seeded chaos: one kill per named worker (staggered by hit count),
+    probabilistic compile delays, rare io flakes."""
+    rng = np.random.default_rng(seed)
+    specs = [
+        FaultSpec(point="runner.round_begin", match=f"{name}:", times=1,
+                  after=int(rng.integers(3, 25)), error="preempt")
+        for name in kill_workers
+    ]
+    specs.append(FaultSpec(point="runner.round_begin", times=-1,
+                           probability=0.1, rounds=[0], error="false",
+                           payload={"delay_s": compile_delay_s}))
+    specs.append(FaultSpec(point="runner.round_begin", times=-1,
+                           probability=io_probability, error="io"))
+    return FaultPlan(seed=seed, specs=specs)
+
+
+def run_storm(mode: str = "pool", n_tasks: int = 200, seed: int = 0,
+              n_workers: int = 3, n_supervisors: int = 2,
+              n_kills: int = 2, n_submitters: int = 8,
+              include_oom: Optional[bool] = None,
+              max_queue: int = 512, timeout_s: float = 180.0,
+              db_path: Optional[str] = None,
+              log: Optional[ResilienceLog] = None) -> Dict[str, Any]:
+    """One full storm; returns the result record (see keys below).
+
+    ``include_oom`` defaults to pool mode only (FIFO has no admission and
+    would strand oversized tasks QUEUED forever by design).
+    """
+    assert mode in ("pool", "fifo"), mode
+    if include_oom is None:
+        include_oom = mode == "pool"
+    log = log if log is not None else ResilienceLog()
+    rng = np.random.default_rng(seed)
+    tmp = None
+    if db_path is None:
+        tmp = tempfile.mkdtemp(prefix="storm_")
+        db_path = os.path.join(tmp, "tasks.db")
+    ledger = StormLedger()
+
+    workers = [StormWorker(f"w{i}", db_path, mode, ledger,
+                           max_queue=max_queue, log=log)
+               for i in range(n_workers)]
+    kill_names = [w.name for w in
+                  rng.choice(workers, size=min(n_kills, n_workers),
+                             replace=False)]
+    plan = build_fault_plan(seed, kill_names)
+
+    sup_repos = [TaskTableRepo(sqlite_path=db_path)
+                 for _ in range(n_supervisors)]
+
+    def sup_factory(repo):
+        def make(tc, stop_event):
+            return StormRunner(tc, stop_event, None, ledger, repo,
+                               worker_name="supervisor")
+        return make
+
+    supervisors = [
+        TaskSupervisor(task_repo=repo, runner_factory=sup_factory(repo),
+                       lease_ttl=1.0, scan_interval=0.1,
+                       backoff_base_s=0.05, resume_budget=4, log=log)
+        for repo in sup_repos
+    ]
+
+    # The task mix, seeded: weighted families plus (pool mode) a few
+    # oversized admission-bait tasks.
+    fam_names = list(FAMILIES)
+    weights = np.array([FAMILIES[f]["weight"] for f in fam_names], float)
+    weights /= weights.sum()
+    tasks: List[Dict[str, Any]] = []
+    for i in range(n_tasks):
+        fam = str(rng.choice(fam_names, p=weights))
+        tasks.append({"task_id": f"storm-{mode}-{i:04d}", "family": fam,
+                      "spec": FAMILIES[fam]})
+    oom_ids: List[str] = []
+    if include_oom:
+        for i in range(max(1, n_tasks // 50)):
+            tid = f"storm-{mode}-oom{i:02d}"
+            oom_ids.append(tid)
+            tasks.append({"task_id": tid, "family": "oom_bait",
+                          "spec": OOM_FAMILY})
+    order = rng.permutation(len(tasks))
+
+    results: Dict[str, Any] = {"rejected": [], "submit_errors": []}
+    replacements: List[StormWorker] = []
+    stop_replacer = threading.Event()
+
+    def replacer():
+        """Autoscaler stand-in: boot a replacement manager for each dead
+        worker so its stranded QUEUED rows are re-adopted."""
+        seen = set()
+        while not stop_replacer.is_set():
+            for w in workers:
+                if w.dead.is_set() and w.name not in seen:
+                    seen.add(w.name)
+                    r = StormWorker(f"{w.name}r", db_path, mode, ledger,
+                                    max_queue=max_queue, log=log)
+                    replacements.append(r)
+                    r.start()
+            stop_replacer.wait(0.2)
+
+    from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+
+    def submitter(idx: int):
+        srng = np.random.default_rng([seed, idx])
+        for j in range(idx, len(order), n_submitters):
+            entry = tasks[int(order[j])]
+            tid = entry["task_id"]
+            tc = json2taskconfig(json.dumps(
+                make_storm_task_json(tid, entry["family"], entry["spec"])))
+            live = [w for w in workers + replacements
+                    if not w.dead.is_set()]
+            if not live:
+                results["submit_errors"].append((tid, "no live manager"))
+                continue
+            mgr = live[int(srng.integers(len(live)))].manager
+            with ledger.lock:
+                ledger.submit_t[tid] = time.monotonic()
+            try:
+                ok = mgr.submit_task(tc)
+            except Exception as e:  # noqa: BLE001 — a dying manager's
+                # submit is a client-visible RPC error; retry elsewhere
+                results["submit_errors"].append((tid, str(e)))
+                continue
+            if not ok:
+                results["rejected"].append(tid)
+            time.sleep(float(srng.uniform(0, 0.004)))
+
+    t0 = time.monotonic()
+    with faults.chaos(plan, log=log):
+        for w in workers:
+            w.start()
+        for s in supervisors:
+            s.start()
+        rep_thread = threading.Thread(target=replacer, daemon=True)
+        rep_thread.start()
+        threads = [threading.Thread(target=submitter, args=(i,), daemon=True)
+                   for i in range(n_submitters)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        # Drain: poll the shared table until every submitted task is
+        # terminal (or timeout — the storm test fails on leftovers).
+        poll = TaskTableRepo(sqlite_path=db_path)
+        terminal = {TaskStatus.SUCCEEDED.name, TaskStatus.FAILED.name,
+                    TaskStatus.STOPPED.name}
+        pending: List[str] = []
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            rows = {r["task_id"]: r.get("task_status")
+                    for r in poll.query_all()}
+            pending = [t["task_id"] for t in tasks
+                       if rows.get(t["task_id"]) not in terminal]
+            if not pending:
+                break
+            time.sleep(0.2)
+        wall = time.monotonic() - t0
+        stop_replacer.set()
+        rep_thread.join(timeout=5)
+        for s in supervisors:
+            s.stop()
+        for w in workers + replacements:
+            w.stop()
+
+    rows = {r["task_id"]: r for r in poll.query_all()}
+    statuses = {t["task_id"]: (rows.get(t["task_id"]) or {}).get(
+        "task_status") for t in tasks}
+    by_status: Dict[str, int] = {}
+    for s in statuses.values():
+        by_status[str(s)] = by_status.get(str(s), 0) + 1
+    waits = sorted(ledger.waits())
+
+    def pct(p):
+        if not waits:
+            return None
+        return float(waits[min(len(waits) - 1,
+                               int(round(p * (len(waits) - 1))))])
+
+    return {
+        "mode": mode,
+        "n_tasks": len(tasks),
+        "seed": seed,
+        "wall_s": round(wall, 3),
+        "statuses": by_status,
+        "pending": pending,
+        "double_runs": ledger.double_runs,
+        "launched": len(waits),
+        "rejected": sorted(set(results["rejected"])),
+        "oom_ids": oom_ids,
+        "submit_errors": results["submit_errors"],
+        "kills": ledger.kills,
+        "io_faults": ledger.io_faults,
+        "resumes": log.count(TASK_RESUMED),
+        "migrations": log.count(TASK_MIGRATED),
+        "admission_rejections": log.count(ADMISSION_REJECTED),
+        "wait_p50_s": pct(0.50),
+        "wait_p95_s": pct(0.95),
+        "wait_max_s": pct(1.0),
+        "device_rounds": ledger.device_rounds,
+        "device_rounds_per_sec": round(ledger.device_rounds / wall, 1),
+        "statuses_by_task": statuses,
+    }
+
+
+def assert_storm_invariants(result: Dict[str, Any]) -> None:
+    """The acceptance invariants (shared by the tests and bench mode)."""
+    assert not result["pending"], (
+        f"{len(result['pending'])} tasks never reached a terminal state: "
+        f"{result['pending'][:10]}"
+    )
+    assert not result["double_runs"], (
+        f"exactly-once violated for {sorted(set(result['double_runs']))}"
+    )
+    for tid in result["oom_ids"]:
+        assert result["statuses_by_task"][tid] == TaskStatus.FAILED.name, \
+            f"oversized task {tid} was not admission-failed"
+        assert tid in result["rejected"], tid
+    unknown = [t for t, s in result["statuses_by_task"].items()
+               if s not in (TaskStatus.SUCCEEDED.name,
+                            TaskStatus.FAILED.name,
+                            TaskStatus.STOPPED.name)]
+    assert not unknown, unknown
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tasks", type=int, default=220)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--kills", type=int, default=2)
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "BENCH_scheduler.json"))
+    args = ap.parse_args(argv)
+
+    entries = []
+    for mode in ("fifo", "pool"):
+        print(f"bench_scheduler: storm mode={mode} "
+              f"tasks={args.tasks} ...", flush=True)
+        result = run_storm(mode=mode, n_tasks=args.tasks, seed=args.seed,
+                           n_workers=args.workers, n_kills=args.kills)
+        assert_storm_invariants(result)
+        result.pop("statuses_by_task")
+        result["family"] = f"scheduler_storm_{mode}"
+        result["backend"] = "cpu"
+        result["degraded"] = True
+        entries.append(result)
+        print(f"  wall={result['wall_s']}s p95_wait={result['wait_p95_s']}s "
+              f"device_rounds/s={result['device_rounds_per_sec']} "
+              f"resumes={result['resumes']} "
+              f"migrations={result['migrations']} "
+              f"rejections={result['admission_rejections']}")
+
+    fifo, pool = entries
+    record = {
+        "captured_unix": time.time(),
+        "backend": "cpu",
+        "degraded": True,
+        "family": "scheduler_storm",
+        "note": (
+            "Submit-storm chaos harness: mixed sync/async/streamed stub "
+            "families against one shared sqlite task table across several "
+            "managers, with seeded worker kills (lease-expiry resume via "
+            "standalone supervisors) and compile-delay/io chaos. fifo = "
+            "the reference's strict FIFO queue pop (head-of-line) over a "
+            "cpu-ledger; pool = chip-pool cost-model scheduler (admission "
+            "+ bin-packing + planned migration) at identical capacity. "
+            "CPU entries are degraded measurements; waits are "
+            "submit->first-launch."
+        ),
+        "p95_wait_speedup_vs_fifo": (
+            round(fifo["wait_p95_s"] / pool["wait_p95_s"], 2)
+            if fifo["wait_p95_s"] and pool["wait_p95_s"] else None
+        ),
+        "entries": entries,
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(f"bench_scheduler: banked -> {args.out} "
+          f"(p95 wait fifo={fifo['wait_p95_s']}s "
+          f"pool={pool['wait_p95_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

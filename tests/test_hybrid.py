@@ -76,6 +76,70 @@ def test_running_response_reserved_for_phones():
     assert alloc_d[0] == 40
 
 
+# ------------------------------------------------- edge cases (satellite)
+def test_zero_phones_and_zero_units_class():
+    """A class with neither phones nor logical units: the f==0 branch wins
+    (all device-rounds routed to the absent device half is the reference's
+    degenerate answer; validation upstream refuses such submissions)."""
+    alloc_l, alloc_d = auto_allocation_hybrid_task(
+        {"N": [10], "q": [0], "f": [0], "k": [1], "m": [0]}
+    )
+    assert alloc_l == [0]
+    assert alloc_d == [10]
+
+
+def test_zero_total_rounds_class():
+    alloc_l, alloc_d = auto_allocation_hybrid_task(
+        {"N": [0], "q": [0], "f": [4], "k": [1], "m": [3]}
+    )
+    assert alloc_l == [0]
+    assert alloc_d == [0]
+
+
+def test_infeasible_demand_all_rounds_pinned_to_phones():
+    """q == N: every round is a measurement round pinned to phones —
+    nothing is optimizable and the logical share must be exactly 0."""
+    alloc_l, alloc_d = auto_allocation_hybrid_task(
+        {"N": [50], "q": [50], "f": [8], "k": [1], "m": [5]}
+    )
+    assert alloc_l == [0]
+    assert alloc_d == [50]
+
+
+def test_brute_force_fallback_agrees_with_milp(monkeypatch):
+    """Force the MILP path off: the brute-force fallback must produce an
+    allocation with the same global makespan on small instances (both are
+    exact optimizers; ties may differ in x, never in objective)."""
+    import olearning_sim_tpu.taskmgr.hybrid as hybrid
+
+    cm = CostModel(alpha=2.0, beta=0.3, lam=4.0)
+    cases = [
+        {"N": [30], "q": [0], "f": [3], "k": [1], "m": [4]},
+        {"N": [25, 40], "q": [5, 0], "f": [2, 5], "k": [2, 1], "m": [3, 8]},
+        {"N": [12, 9, 18], "q": [0, 3, 2], "f": [1, 2, 3], "k": [1, 1, 2],
+         "m": [2, 1, 4]},
+    ]
+
+    def span(data, xs):
+        return max(
+            _makespan(x, N, q, f, k, m, cm)
+            for x, N, q, f, k, m in zip(xs, data["N"], data["q"], data["f"],
+                                        data["k"], data["m"])
+        )
+
+    for data in cases:
+        milp_l, milp_d = auto_allocation_hybrid_task(dict(data), cm)
+        monkeypatch.setattr(hybrid, "_solve_milp", lambda *a, **k: None)
+        brute_l, brute_d = auto_allocation_hybrid_task(dict(data), cm)
+        monkeypatch.undo()
+        # Feasibility of both answers.
+        for al, ad, N in zip(brute_l, brute_d, data["N"]):
+            assert al >= 0 and ad >= 0 and al + ad == N
+        for al, ad, N in zip(milp_l, milp_d, data["N"]):
+            assert al >= 0 and ad >= 0 and al + ad == N
+        assert span(data, milp_l) == pytest.approx(span(data, brute_l))
+
+
 def test_fix_data_parameters_fills_allocations():
     js = make_task_json("hybrid_task")
     td = js["target"]["data"][0]

@@ -1,0 +1,670 @@
+"""Chip-pool control plane: cost-model admission, bin-packing strategy,
+planned preemption/migration (bitwise), and the submit-storm chaos harness
+(scripts/bench_scheduler.py).
+
+The quick storm runs in tier-1/CI; the >=200-task acceptance storm is
+slow-marked (run locally / by the bench)."""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from test_taskmgr import make_task_json, wait_for
+
+from olearning_sim_tpu.resilience import (
+    FaultPlan,
+    FaultSpec,
+    ResilienceLog,
+    faults,
+)
+from olearning_sim_tpu.resilience.events import (
+    ADMISSION_REJECTED,
+    CRASH_LOOP,
+    TASK_MIGRATED,
+    TASK_PREEMPTED,
+)
+from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+from olearning_sim_tpu.taskmgr.pool import (
+    ChipPool,
+    CostOracle,
+    MeshSpec,
+    PoolScheduler,
+    TaskCost,
+)
+from olearning_sim_tpu.taskmgr.status import TaskStatus
+from olearning_sim_tpu.taskmgr.task_manager import TaskManager
+from olearning_sim_tpu.taskmgr.task_repo import TaskTableRepo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GIB = 1 << 30
+
+
+@pytest.fixture(scope="module")
+def harness():
+    """Import scripts/bench_scheduler.py without running its __main__."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_scheduler", os.path.join(REPO, "scripts",
+                                        "bench_scheduler.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_scheduler"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def sched_task_json(task_id, *, hbm_gb=1.0, priority=0, rounds=2,
+                    round_time_s=0.01, compile_s=0.0, deadline_s=None,
+                    preemptible=True):
+    """A real-engine task json with an explicit scheduling cost block."""
+    js = make_task_json(task_id, rounds=rounds)
+    op = js["operatorflow"]["operators"][0]["logical_simulation"]
+    params = json.loads(op["operator_params"])
+    params["scheduling"] = {
+        "peak_hbm_bytes": hbm_gb * GIB,
+        "round_time_s": round_time_s,
+        "compile_s": compile_s,
+        "preemptible": preemptible,
+    }
+    if deadline_s is not None:
+        params["scheduling"]["deadline_s"] = deadline_s
+    op["operator_params"] = json.dumps(params)
+    js["target"]["priority"] = priority
+    return js
+
+
+# ------------------------------------------------------------- cost oracle
+def test_cost_oracle_precedence():
+    oracle = CostOracle()
+    tc = json2taskconfig(sched_task_json("c1", hbm_gb=3.0,
+                                         round_time_s=0.5, compile_s=2.0))
+    cost = oracle.estimate(tc)
+    assert cost.source == "scheduling_params"
+    assert cost.peak_hbm_bytes == 3.0 * GIB
+    assert cost.rounds == 2
+    assert cost.runtime_estimate_s() == pytest.approx(2.0 + 2 * 0.5)
+
+    # Measured family records win over defaults for tasks with no
+    # explicit block (telemetry-fed path).
+    plain = json2taskconfig(make_task_json("c2"))
+    family = CostOracle.family_of(plain)
+    assert family == "fedavg_mlp2"
+    oracle.record_measurement(family, round_time_s=0.25, compile_s=1.5,
+                              peak_hbm_bytes=123456.0)
+    cost2 = oracle.estimate(plain)
+    assert cost2.source == "measured"
+    assert cost2.round_time_s == 0.25
+    assert cost2.peak_hbm_bytes == 123456.0
+
+    # Bench records are ingestible as-is (BENCH suite entry shape).
+    oracle2 = CostOracle(bench_records=[
+        {"family": family, "rounds_per_sec": 4.0, "compile_sec": 7.0},
+    ])
+    cost3 = oracle2.estimate(plain)
+    assert cost3.round_time_s == pytest.approx(0.25)
+    assert cost3.compile_s == 7.0
+
+
+def test_cost_oracle_static_hbm_feed():
+    """With nothing measured, peak HBM comes from the PR 7 HLO budget
+    audit (static memory oracle), scaled to the task's population."""
+    oracle = CostOracle()
+    plain = json2taskconfig(make_task_json("c3", num_clients=24))
+    cost = oracle.estimate(plain)
+    assert cost.source == "static_hbm"
+    expected = oracle.static_peak_hbm(24)
+    assert expected is not None and cost.peak_hbm_bytes == expected
+    # Scaling is monotone in population size.
+    assert oracle.static_peak_hbm(2400) > oracle.static_peak_hbm(24)
+
+
+# ---------------------------------------------------------------- chip pool
+def test_chip_pool_best_fit_and_capacity():
+    pool = ChipPool([MeshSpec("a", hbm_bytes=8 * GIB),
+                     MeshSpec("b", hbm_bytes=4 * GIB)])
+    small = TaskCost(peak_hbm_bytes=3 * GIB)
+    # Best fit: the 4 GiB worker leaves the smaller hole.
+    assert pool.best_fit(small) == "b"
+    assert pool.place("t1", "b", small)
+    assert pool.free_bytes("b") == 1 * GIB
+    # Second 3 GiB task no longer fits on b -> a.
+    assert pool.best_fit(small) == "a"
+    assert pool.place("t2", "a", small)
+    big = TaskCost(peak_hbm_bytes=6 * GIB)
+    assert pool.best_fit(big) is None  # nothing fits now
+    pool.release("t2")
+    assert pool.best_fit(big) == "a"
+    assert pool.release("missing") is None
+
+
+# --------------------------------------------------- admission (pool manager)
+def pool_manager(workers=2, hbm_gb=8.0, max_queue=64, log=None, **mgr_kw):
+    pool = ChipPool([MeshSpec(f"w{i}", hbm_bytes=hbm_gb * GIB)
+                     for i in range(workers)])
+    sched = PoolScheduler(pool, CostOracle(), max_queue=max_queue, log=log)
+    mgr = TaskManager(schedule_interval=3600, pool=sched, **mgr_kw)
+    return mgr, sched
+
+
+def test_admission_rejects_oom_placement():
+    """A task whose static-oracle/declared peak HBM exceeds every mesh is
+    refused at submit with admission_rejected — it never launches and
+    never OOMs a worker."""
+    log = ResilienceLog()
+    mgr, _sched = pool_manager(hbm_gb=8.0, log=log)
+    try:
+        assert not mgr.submit_task(
+            json2taskconfig(sched_task_json("oom", hbm_gb=64.0)))
+        assert mgr.get_task_status("oom") == TaskStatus.FAILED
+        events = log.events(ADMISSION_REJECTED, "oom")
+        assert len(events) == 1
+        assert events[0].detail["reason"] == "oom"
+    finally:
+        mgr.stop()
+
+
+def test_admission_backpressure_bounds_queue():
+    log = ResilienceLog()
+    mgr, _sched = pool_manager(max_queue=2, log=log)
+    try:
+        assert mgr.submit_task(json2taskconfig(sched_task_json("q0")))
+        assert mgr.submit_task(json2taskconfig(sched_task_json("q1")))
+        assert not mgr.submit_task(json2taskconfig(sched_task_json("q2")))
+        assert mgr.get_task_status("q2") == TaskStatus.FAILED
+        assert log.events(ADMISSION_REJECTED, "q2")[0].detail["reason"] \
+            == "backpressure"
+        assert mgr.get_task_queue() == ["q0", "q1"]
+    finally:
+        mgr.stop()
+
+
+def test_admission_rejects_blown_deadline():
+    """Deadline-aware admission: with a long backlog already admitted, a
+    task whose deadline cannot be met is refused up-front."""
+    log = ResilienceLog()
+    mgr, sched = pool_manager(workers=1, log=log)
+    try:
+        # 60 s of admitted backlog on a 1-worker pool.
+        assert mgr.submit_task(json2taskconfig(sched_task_json(
+            "long", rounds=60, round_time_s=1.0)))
+        assert sched.estimated_wait_s() >= 60.0
+        assert not mgr.submit_task(json2taskconfig(sched_task_json(
+            "urgent", rounds=1, round_time_s=0.1, deadline_s=5.0)))
+        assert log.events(ADMISSION_REJECTED, "urgent")[0].detail["reason"] \
+            == "deadline"
+        # The same task without the impossible deadline is admitted.
+        assert mgr.submit_task(json2taskconfig(sched_task_json(
+            "patient", rounds=1, round_time_s=0.1)))
+    finally:
+        mgr.stop()
+
+
+def test_scheduler_admit_injection_point():
+    """scheduler.admit chaos point: an injected fault surfaces as a
+    submission error (client retries), leaving the row re-submittable."""
+    log = ResilienceLog()
+    mgr, _sched = pool_manager(log=log)
+    try:
+        plan = FaultPlan(seed=3, specs=[
+            FaultSpec(point="scheduler.admit", times=1, error="io"),
+        ])
+        tc = json2taskconfig(sched_task_json("adm"))
+        with faults.chaos(plan, log=log):
+            with pytest.raises(faults.FaultError):
+                mgr.submit_task(tc)
+        assert log.count("fault_injected") == 1
+        # Chaos off: the retried submission goes through.
+        assert mgr.submit_task(tc)
+        assert mgr.get_task_status("adm") == TaskStatus.QUEUED
+    finally:
+        mgr.stop()
+
+
+# ------------------------------------------------- strategy (packing order)
+def test_strategy_priority_deadline_then_sjf():
+    mgr, sched = pool_manager(workers=1, hbm_gb=8.0)
+    try:
+        assert mgr.submit_task(json2taskconfig(sched_task_json(
+            "slow_low", rounds=50, round_time_s=1.0, priority=0)))
+        assert mgr.submit_task(json2taskconfig(sched_task_json(
+            "fast_low", rounds=1, round_time_s=0.01, priority=0)))
+        assert mgr.submit_task(json2taskconfig(sched_task_json(
+            "slow_high", rounds=50, round_time_s=1.0, priority=9)))
+        queue = mgr._task_queue.get_task_queue()
+        avail = {"logical_simulation": {"cpu": float("inf"),
+                                        "mem": float("inf")},
+                 "device_simulation": {}}
+        # Priority wins first...
+        pick = sched.schedule_next_task(queue, avail)
+        assert pick.task.taskID.taskID == "slow_high"
+        assert pick.worker == "w0"
+        sched.abort_launch("slow_high")
+        # ...then, at equal priority, shortest estimated runtime (SJF).
+        queue = [tc for tc in queue
+                 if tc.taskID.taskID != "slow_high"]
+        pick = sched.schedule_next_task(queue, avail)
+        assert pick.task.taskID.taskID == "fast_low"
+    finally:
+        mgr.stop()
+
+
+def test_strategy_skips_tasks_that_do_not_fit_now():
+    """A big task is skipped (not crashed, not blocking) while the pool is
+    full; the starved slot is exposed to the rebalancer."""
+    mgr, sched = pool_manager(workers=1, hbm_gb=8.0)
+    try:
+        sched.pool.place("resident", "w0",
+                         TaskCost(peak_hbm_bytes=6 * GIB), priority=0)
+        assert mgr.submit_task(json2taskconfig(sched_task_json(
+            "big", hbm_gb=4.0, priority=7)))
+        queue = mgr._task_queue.get_task_queue()
+        avail = {"logical_simulation": {"cpu": float("inf"),
+                                        "mem": float("inf")},
+                 "device_simulation": {}}
+        assert sched.schedule_next_task(queue, avail) is None
+        assert sched._starved is not None
+        assert sched._starved[0] == "big"
+    finally:
+        mgr.stop()
+
+
+# --------------------------------------------- migration (bitwise + budget)
+class _SlowStepRunner:
+    """Wraps the real engine runner's begin/step API with a per-round
+    sleep so a migration can land mid-run deterministically."""
+
+    def __init__(self, inner, round_sleep_s):
+        self.inner = inner
+        self.round_sleep_s = round_sleep_s
+
+    @property
+    def stopped(self):
+        return self.inner.stopped
+
+    def run(self):
+        self.inner.begin()
+        while self.inner.step():
+            time.sleep(self.round_sleep_s)
+        return self.inner.finish()
+
+
+def _engine_pool_manager(tmp_path, task_id, rounds, round_sleep_s=0.4):
+    from olearning_sim_tpu.engine.task_bridge import (
+        build_runner_from_taskconfig,
+    )
+
+    js = sched_task_json(task_id, hbm_gb=2.0, rounds=rounds)
+    op = js["operatorflow"]["operators"][0]["logical_simulation"]
+    params = json.loads(op["operator_params"])
+    params["checkpoint"] = {"directory": str(tmp_path / "{task_id}"),
+                            "every": 1}
+    op["operator_params"] = json.dumps(params)
+    repo = TaskTableRepo()
+
+    def factory(tc, stop_event):
+        inner = build_runner_from_taskconfig(
+            tc, task_repo=repo, stop_event=stop_event)
+        return _SlowStepRunner(inner, round_sleep_s)
+
+    pool = ChipPool([MeshSpec("w0", hbm_bytes=8 * GIB),
+                     MeshSpec("w1", hbm_bytes=8 * GIB)])
+    sched = PoolScheduler(pool, CostOracle())
+    mgr = TaskManager(task_repo=repo, runner_factory=factory, pool=sched,
+                      schedule_interval=0.02, release_interval=0.05,
+                      interrupt_interval=3600)
+    return mgr, sched, js
+
+
+def _final_states(launcher, job_id):
+    job = launcher.get_job(job_id)
+    assert job is not None, job_id
+    runner = job.runner.inner
+    return runner.states
+
+
+def _leaf_arrays(tree):
+    import jax
+    import numpy as np
+
+    out = []
+    for x in jax.tree_util.tree_leaves(tree):
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            x = jax.random.key_data(x)
+        out.append(np.asarray(x))
+    return out
+
+
+def test_planned_migration_resumes_bitwise(tmp_path):
+    """The acceptance check: a task preempted at a round boundary and
+    migrated to another worker finishes with a final model bitwise equal
+    to an unpreempted run of the same task."""
+    import numpy as np
+
+    rounds = 4
+    # Clean (unpreempted) reference run.
+    mgr_a, _sched_a, js = _engine_pool_manager(tmp_path / "clean", "migbit",
+                                               rounds, round_sleep_s=0.0)
+    mgr_a.start()
+    try:
+        assert mgr_a.submit_task(json2taskconfig(js))
+        assert wait_for(lambda: mgr_a.get_task_status("migbit")
+                        == TaskStatus.SUCCEEDED, timeout=120)
+        clean_leaves = _leaf_arrays(
+            _final_states(mgr_a._launcher, "job-migbit"))
+    finally:
+        mgr_a.stop()
+
+    # Migrated run: same task id (same seed), fresh repo + checkpoint dir.
+    log = ResilienceLog()
+    mgr_b, sched_b, js2 = _engine_pool_manager(tmp_path / "mig", "migbit",
+                                               rounds, round_sleep_s=0.4)
+    sched_b.log = log
+    mgr_b.start()
+    try:
+        assert mgr_b.submit_task(json2taskconfig(js2))
+        repo = mgr_b._task_repo
+        # Wait until at least one round is durably done, then preempt.
+        assert wait_for(
+            lambda: (repo.get_item_value("migbit", "logical_round") or 0)
+            and int(repo.get_item_value("migbit", "logical_round")) >= 1,
+            timeout=120,
+        )
+        src_worker = repo.get_item_value("migbit", "worker_id")
+        assert src_worker == "w0"
+        outcome = sched_b.migrate("migbit", "w1", reason="test")
+        assert outcome == "migrated"
+        assert repo.get_item_value("migbit", "worker_id") == "w1"
+        assert repo.get_item_value("migbit", "job_id") == "job-migbit~m1"
+        assert json.loads(
+            repo.get_item_value("migbit", "supervision"))["resumes"] == 1
+        assert log.count(TASK_PREEMPTED, "migbit") == 1
+        assert log.count(TASK_MIGRATED, "migbit") == 1
+        assert wait_for(lambda: mgr_b.get_task_status("migbit")
+                        == TaskStatus.SUCCEEDED, timeout=120)
+        mig_leaves = _leaf_arrays(
+            _final_states(mgr_b._launcher, "job-migbit~m1"))
+    finally:
+        mgr_b.stop()
+
+    assert len(clean_leaves) == len(mig_leaves)
+    for a, b in zip(clean_leaves, mig_leaves):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.array_equal(a, b), "migrated run diverged (non-bitwise)"
+
+
+def test_migration_storm_degrades_to_fail_task():
+    """Resume budget is SHARED with supervisor crash-loop accounting: a
+    storm of preemptions exhausts it and the task fails loudly — never a
+    migrate livelock."""
+    log = ResilienceLog()
+
+    class GatedRunner:
+        stopped = False
+
+        def __init__(self, stop_event):
+            self._stop = stop_event
+
+        def run(self):
+            self._stop.wait(30)
+            self.stopped = self._stop.is_set()
+
+    pool = ChipPool([MeshSpec("w0", hbm_bytes=8 * GIB),
+                     MeshSpec("w1", hbm_bytes=8 * GIB)])
+    sched = PoolScheduler(pool, CostOracle(), resume_budget=2, log=log)
+    mgr = TaskManager(schedule_interval=3600, pool=sched,
+                      runner_factory=lambda tc, ev: GatedRunner(ev))
+    try:
+        assert mgr.submit_task(json2taskconfig(sched_task_json("thrash")))
+        assert mgr.schedule_once() == "thrash"
+        assert sched.migrate("thrash") == "migrated"
+        assert sched.migrate("thrash") == "migrated"
+        # Budget (2) spent: the third preemption degrades to FAIL_TASK.
+        assert sched.migrate("thrash") == "failed"
+        assert mgr.get_task_status("thrash") == TaskStatus.FAILED
+        assert log.count(CRASH_LOOP, "thrash") == 1
+        assert log.count(TASK_MIGRATED, "thrash") == 2
+        assert pool.placement("thrash") is None
+    finally:
+        mgr.stop()
+
+
+def test_scheduler_preempt_injection_point():
+    """scheduler.preempt chaos point: a fault before the fence leaves the
+    task running untouched on its worker."""
+    log = ResilienceLog()
+
+    class GatedRunner:
+        stopped = False
+
+        def __init__(self, stop_event):
+            self._stop = stop_event
+
+        def run(self):
+            self._stop.wait(30)
+            self.stopped = self._stop.is_set()
+
+    pool = ChipPool([MeshSpec("w0", hbm_bytes=8 * GIB),
+                     MeshSpec("w1", hbm_bytes=8 * GIB)])
+    sched = PoolScheduler(pool, CostOracle(), log=log)
+    mgr = TaskManager(schedule_interval=3600, pool=sched,
+                      runner_factory=lambda tc, ev: GatedRunner(ev))
+    try:
+        assert mgr.submit_task(json2taskconfig(sched_task_json("pre")))
+        assert mgr.schedule_once() == "pre"
+        plan = FaultPlan(seed=5, specs=[
+            FaultSpec(point="scheduler.preempt", times=1, error="io"),
+        ])
+        with faults.chaos(plan, log=log):
+            with pytest.raises(faults.FaultError):
+                sched.migrate("pre", "w1")
+        assert pool.placement("pre").worker == "w0"
+        assert mgr._launcher.get_job_status("job-pre") == TaskStatus.RUNNING
+        assert log.count(TASK_MIGRATED, "pre") == 0
+        assert mgr.stop_task("pre")
+    finally:
+        mgr.stop()
+
+
+def test_migration_fence_timeout_withdraws_stop():
+    """A victim that cannot reach a round boundary within the fence
+    timeout is left GENUINELY running: the stop request is withdrawn, no
+    budget is charged, and the job later finishes SUCCEEDED instead of
+    being stranded STOPPED with nobody to relaunch it."""
+    log = ResilienceLog()
+
+    class StubbornRunner:
+        """Ignores the stop event for a while (a long round), then
+        completes normally if the stop was withdrawn."""
+
+        stopped = False
+
+        def __init__(self, stop_event):
+            self._stop = stop_event
+
+        def run(self):
+            time.sleep(1.0)  # "mid-round": cannot honor the fence yet
+            if self._stop.is_set():
+                self.stopped = True
+
+    pool = ChipPool([MeshSpec("w0", hbm_bytes=8 * GIB),
+                     MeshSpec("w1", hbm_bytes=8 * GIB)])
+    sched = PoolScheduler(pool, CostOracle(), log=log)
+    mgr = TaskManager(schedule_interval=3600, pool=sched,
+                      runner_factory=lambda tc, ev: StubbornRunner(ev))
+    try:
+        assert mgr.submit_task(json2taskconfig(sched_task_json("stub")))
+        assert mgr.schedule_once() == "stub"
+        assert sched.migrate("stub", "w1", fence_timeout_s=0.1) == "skipped"
+        assert pool.placement("stub").worker == "w0"  # untouched
+        assert log.count(TASK_MIGRATED, "stub") == 0
+        assert (json.loads(
+            mgr._task_repo.get_item_value("stub", "supervision") or "{}"
+        ).get("resumes", 0)) == 0  # no budget charged
+        # The stop was withdrawn: the job completes normally.
+        assert wait_for(lambda: mgr._launcher.get_job_status("job-stub")
+                        == TaskStatus.SUCCEEDED, timeout=30)
+        job = mgr._launcher.get_job("job-stub")
+        assert job.runner.stopped is False
+    finally:
+        mgr.stop()
+
+
+def test_rebalancer_migrates_victim_for_starved_high_priority():
+    """End-to-end preemption trigger: a starved high-priority task makes
+    the rebalancer migrate a low-priority resident to the other worker,
+    after which the scheduler can place the starved task."""
+
+    class GatedRunner:
+        stopped = False
+
+        def __init__(self, stop_event):
+            self._stop = stop_event
+
+        def run(self):
+            self._stop.wait(30)
+            self.stopped = self._stop.is_set()
+
+    log = ResilienceLog()
+    pool = ChipPool([MeshSpec("w0", hbm_bytes=8 * GIB),
+                     MeshSpec("w1", hbm_bytes=8 * GIB)])
+    sched = PoolScheduler(pool, CostOracle(), log=log)
+    mgr = TaskManager(schedule_interval=3600, pool=sched,
+                      runner_factory=lambda tc, ev: GatedRunner(ev))
+    try:
+        # Two low-priority residents, one per worker (6 GiB each).
+        for tid in ("res0", "res1"):
+            assert mgr.submit_task(json2taskconfig(
+                sched_task_json(tid, hbm_gb=6.0, priority=0)))
+            assert mgr.schedule_once() == tid
+        assert {pool.placement(t).worker for t in ("res0", "res1")} \
+            == {"w0", "w1"}
+        # 4 GiB high-priority task: fits nowhere until a resident moves...
+        assert mgr.submit_task(json2taskconfig(
+            sched_task_json("vip", hbm_gb=4.0, priority=9)))
+        assert mgr.schedule_once() is None
+        # ...but both workers are full, so migration has no landing spot:
+        # the rebalancer must NOT evict into nowhere.
+        assert sched.rebalance_once()["migrated"] == []
+        # Free w1: now the rebalancer can move res0 (or res1) across...
+        mgr.stop_task("res1")
+        assert wait_for(lambda: mgr._launcher.get_job_status("job-res1")
+                        == TaskStatus.STOPPED)
+        mgr.release_once()
+        assert pool.placement("res1") is None
+        digest = sched.rebalance_once()
+        assert digest["migrated"] == ["res0"]
+        assert pool.placement("res0").worker == "w1"
+        # ...and the starved vip schedules onto the freed worker.
+        assert mgr.schedule_once() == "vip"
+        assert pool.placement("vip").worker == "w0"
+        assert log.count(TASK_MIGRATED) == 1
+    finally:
+        mgr.stop()
+
+
+# ------------------------------------------- fifo baseline + stranded rescue
+def test_fifo_pop_strategy_head_of_line_blocks():
+    """The bench baseline is the reference's strict FIFO pop: the head
+    launches when it fits; nothing overtakes it."""
+    from olearning_sim_tpu.taskmgr.scheduler import (
+        FifoPopStrategy,
+        StrategyFactory,
+    )
+
+    assert isinstance(StrategyFactory.create_strategy("fifo"),
+                      FifoPopStrategy)
+    big = json2taskconfig(make_task_json("big", cpus=10, request_units=10))
+    small = json2taskconfig(make_task_json("small", cpus=1,
+                                           request_units=1))
+    strat = FifoPopStrategy()
+    tight = {"logical_simulation": {"cpu": 2, "mem": 100},
+             "device_simulation": {}}
+    # Head doesn't fit: NOTHING launches (head-of-line blocking) — the
+    # pathology the cost-model scheduler is measured against.
+    assert strat.schedule_next_task([big, small], tight) is None
+    roomy = {"logical_simulation": {"cpu": 100, "mem": 100},
+             "device_simulation": {}}
+    assert strat.schedule_next_task(
+        [big, small], roomy).task.taskID.taskID == "big"
+
+
+def test_adopt_stranded_queued_row():
+    """A QUEUED row stuck in a dead sibling manager's in-memory queue is
+    re-adopted by a live manager's adopt_stranded_once sweep."""
+    repo = TaskTableRepo()
+    a = TaskManager(task_repo=repo, schedule_interval=3600)
+    b = TaskManager(task_repo=repo, schedule_interval=3600,
+                    adopt_stranded_after=0.5)
+    try:
+        # Submitted to A AFTER B booted: only A's memory queue has it.
+        assert a.submit_task(json2taskconfig(make_task_json("stranded")))
+        a.stop()  # A dies without launching
+        assert b.get_task_queue() == []
+        # Too young: not adopted yet (the age gate avoids stealing from a
+        # live sibling that is just slow).
+        assert b.adopt_stranded_once(now=time.time()) == 0 \
+            or b.get_task_queue() == ["stranded"]
+        b._last_adopt_scan = 0.0
+        assert b.adopt_stranded_once(now=time.time() + 60.0) in (0, 1)
+        assert b.get_task_queue() == ["stranded"]
+        # Idempotent: a second sweep does not double-queue.
+        b._last_adopt_scan = 0.0
+        assert b.adopt_stranded_once(now=time.time() + 120.0) == 0
+        assert b.get_task_queue() == ["stranded"]
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ------------------------------------------------------------ submit storm
+def test_submit_storm_quick(harness):
+    """Tier-1 storm: concurrent mixed-family submissions over one shared
+    sqlite table, one seeded worker kill, compile delays and io flakes —
+    no task lost, none double-run, every task terminal, the oversized
+    task admission-failed, and at least one kill-orphaned task resumed."""
+    log = ResilienceLog()
+    result = harness.run_storm(
+        mode="pool", n_tasks=48, seed=11, n_workers=2, n_supervisors=1,
+        n_kills=1, n_submitters=6, timeout_s=90.0, log=log,
+    )
+    harness.assert_storm_invariants(result)
+    assert result["kills"] == 1
+    assert result["admission_rejections"] >= 1
+    assert result["resumes"] >= 1, result
+    assert result["launched"] > 0 and result["wait_p95_s"] is not None
+
+
+@pytest.mark.slow
+def test_submit_storm_acceptance(harness):
+    """The >=200-task acceptance storm (ISSUE 12): multiple worker kills,
+    two racing supervisors, mixed families — every task terminal, none
+    lost or double-run."""
+    log = ResilienceLog()
+    result = harness.run_storm(
+        mode="pool", n_tasks=208, seed=7, n_workers=3, n_supervisors=2,
+        n_kills=2, n_submitters=8, timeout_s=240.0, log=log,
+    )
+    harness.assert_storm_invariants(result)
+    assert result["n_tasks"] >= 200
+    assert result["kills"] == 2
+    assert result["resumes"] >= 1
+    assert result["admission_rejections"] >= 1
+    succeeded = result["statuses"].get("SUCCEEDED", 0)
+    assert succeeded >= result["n_tasks"] * 0.8, result["statuses"]
+
+
+@pytest.mark.slow
+def test_submit_storm_fifo_baseline(harness):
+    """The FIFO baseline survives the same storm (invariants hold); the
+    cost-model-vs-FIFO p95 comparison is banked by the bench."""
+    result = harness.run_storm(
+        mode="fifo", n_tasks=96, seed=7, n_workers=3, n_supervisors=1,
+        n_kills=1, n_submitters=8, timeout_s=240.0,
+    )
+    harness.assert_storm_invariants(result)
+    assert result["resumes"] >= 0 and result["launched"] > 0

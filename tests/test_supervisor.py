@@ -99,6 +99,126 @@ def test_lease_claim_is_atomic_under_contention(tmp_path):
     assert repo.lease_info("t")[0] == winners[0]
 
 
+# ---------------------------------------- locked-retry under storm (satellite)
+class _FlakyConn:
+    """Connection proxy raising 'database is locked' for the first N
+    statements matching ``prefix`` (sqlite3.Connection itself is
+    monkeypatch-proof)."""
+
+    def __init__(self, conn, prefix, n, message="database is locked"):
+        import sqlite3
+
+        self._conn = conn
+        self._prefix = prefix
+        self.remaining = n
+        self._exc = sqlite3.OperationalError(message)
+
+    def execute(self, sql, *args):
+        if sql.lstrip().upper().startswith(self._prefix) \
+                and self.remaining > 0:
+            self.remaining -= 1
+            raise self._exc
+        return self._conn.execute(sql, *args)
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+
+def test_claim_row_retries_database_locked(tmp_path):
+    """Regression: WAL + busy_timeout alone is not enough at hundreds of
+    writers — a transient 'database is locked' on the lease CAS must be
+    absorbed by the bounded RetryPolicy, not read as a lost arbitration."""
+    repo = TaskTableRepo(backend=SqliteTableRepo(
+        str(tmp_path / "locked.db"), "taskmgr_table", TASK_COLUMNS
+    ))
+    repo.add_task("t1")
+    backend = repo.backend
+    real_conn = backend._conn
+    flaky = _FlakyConn(real_conn, "UPDATE", 3)
+    backend._conn = flaky
+    try:
+        assert repo.claim_lease("t1", "A", ttl_s=60, now=100.0)
+    finally:
+        backend._conn = real_conn
+    assert flaky.remaining == 0  # all three injected errors were retried
+    assert repo.lease_info("t1")[0] == "A"
+
+    # A non-locked OperationalError still propagates to the False contract
+    # immediately (no retry burn).
+    broken = _FlakyConn(real_conn, "UPDATE", 10**6,
+                        message="no such table: nope")
+    backend._conn = broken
+    try:
+        assert not repo.claim_lease("t1", "B", ttl_s=60, now=1e9)
+    finally:
+        backend._conn = real_conn
+    assert broken.remaining == 10**6 - 1  # one attempt, no retries
+
+
+def test_queue_pop_retries_database_locked(tmp_path):
+    from olearning_sim_tpu.taskmgr.queue_repo import SqliteQueueRepo
+
+    q = SqliteQueueRepo(str(tmp_path / "lockq.db"))
+    q.push("payload")
+    real_conn = q._conn
+    flaky = _FlakyConn(real_conn, "BEGIN", 2)
+    q._conn = flaky
+    try:
+        assert q.pop() == "payload"
+    finally:
+        q._conn = real_conn
+    assert flaky.remaining == 0
+    q.close()
+
+
+# ------------------------------------- multi-supervisor reclaim race (satellite)
+def test_two_supervisors_race_one_expired_lease():
+    """Two supervisors scanning the same expired RUNNING row: exactly one
+    wins the lease CAS and relaunches; the loser backs off cleanly — no
+    duplicate relaunch, no second job, no budget double-charge."""
+    log = ResilienceLog()
+    repo = _orphan_repo("race")
+    built = []
+    lock = threading.Lock()
+
+    def factory(tag):
+        def make(tc, stop_event):
+            with lock:
+                built.append(tag)
+            return _OkRunner()
+        return make
+
+    sup_a = TaskSupervisor(task_repo=repo, runner_factory=factory("A"),
+                           backoff_base_s=0.0, log=log)
+    sup_b = TaskSupervisor(task_repo=repo, runner_factory=factory("B"),
+                           backoff_base_s=0.0, log=log)
+    start = threading.Barrier(2)
+    digests = {}
+
+    def scan(name, sup):
+        start.wait()
+        digests[name] = sup.scan_once()
+
+    threads = [threading.Thread(target=scan, args=(n, s))
+               for n, s in (("A", sup_a), ("B", sup_b))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    resumed = digests["A"]["resumed"] + digests["B"]["resumed"]
+    assert resumed == ["race"]      # exactly one winner
+    assert len(built) == 1          # exactly one relaunch
+    winner = sup_a if digests["A"]["resumed"] else sup_b
+    assert repo.lease_info("race")[0] == winner.owner_id
+    assert json.loads(
+        repo.get_item_value("race", "supervision")
+    )["resumes"] == 1               # budget charged exactly once
+    assert log.count(TASK_RESUMED, "race") == 1
+    # The loser's next scan leaves the winner's live lease alone.
+    loser = sup_b if winner is sup_a else sup_a
+    assert loser.scan_once()["resumed"] == []
+
+
 # ------------------------------------------- sqlite WAL + busy_timeout (satellite)
 def test_sqlite_concurrent_writers_do_not_lock(tmp_path):
     """Two connections (e.g. supervisor + gRPC thread) hammering one file DB
@@ -386,7 +506,9 @@ def test_heartbeat_transient_renew_failure_does_not_fence():
 
 def test_launch_refused_when_lease_held_elsewhere():
     """The lease is claimed BEFORE the job launches and the RUNNING write:
-    a live foreign lease refuses the double launch outright."""
+    a live foreign lease refuses the double launch outright — and leaves
+    the row to its owner (multi-manager deployments share one task table;
+    stamping FAILED would stomp the owner's live run)."""
     from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
 
     launched = []
@@ -400,8 +522,32 @@ def test_launch_refused_when_lease_held_elsewhere():
         mgr.schedule_once()
         assert launched == []
         assert repo.get_item_value("dbl", "task_status") == \
-            TaskStatus.FAILED.name
+            TaskStatus.QUEUED.name  # the owner's to move on, not ours
         assert repo.lease_info("dbl")[0] == "other-proc"  # untouched
+    finally:
+        mgr.stop()
+
+
+def test_launch_aborts_when_another_manager_moved_the_row():
+    """Exactly-once across managers sharing one task table: a task that
+    left QUEUED (another manager launched or finished it) must not be
+    launched again from a stale in-memory queue."""
+    from olearning_sim_tpu.taskmgr.codecs import json2taskconfig
+
+    launched = []
+    repo = TaskTableRepo()
+    mgr = TaskManager(task_repo=repo, schedule_interval=3600,
+                      runner_factory=lambda tc, ev: launched.append(1)
+                      or _OkRunner())
+    try:
+        assert mgr.submit_task(json2taskconfig(make_task_json("moved")))
+        # Another manager launched it, ran it, and finalized the row.
+        repo.set_item_value("moved", "task_status",
+                            TaskStatus.SUCCEEDED.name)
+        mgr.schedule_once()
+        assert launched == []
+        assert repo.get_item_value("moved", "task_status") == \
+            TaskStatus.SUCCEEDED.name
     finally:
         mgr.stop()
 
