@@ -30,7 +30,11 @@ sync-deadline pair (committed device-rounds/sec at straggler-heavy
 pacing) plus the 2-task multiplex record into ``BENCH_async.json``;
 ``--trace`` banks the million-client trace-driven scenario family
 (lazy host store + block-streamed rounds under diurnal/spike/churn
-availability masks) into ``BENCH_trace.json``. All
+availability masks) into ``BENCH_trace.json``; ``--convergence`` banks
+the time-to-accuracy grid (rounds/seconds-to-target-accuracy per
+(family x engine-config): sync vs async, attacked+defended vs
+undefended, clean vs drift, resident vs streamed) into
+``BENCH_convergence.json``. All
 bench processes share the persistent XLA compile cache
 (``artifacts/xla_compile_cache``; ``OLS_COMPILE_CACHE=0`` disables) and
 record its hit/miss counters per family.
@@ -1526,6 +1530,152 @@ def run_trace_bench(out_name="BENCH_trace.json"):
     return payload
 
 
+# ------------------------------------------------------------ convergence
+# ``--convergence`` banks the time-to-accuracy grid (BENCH_convergence.json;
+# ISSUE 13 / ROADMAP item 4): every row is ONE (family x engine-config)
+# convergence run through the SimulationRunner + ConvergenceTracker
+# (engine/convergence.py — the same harness the analysis/convergence_gate
+# CI gate re-runs at a smaller scale), to a fixed seed and round budget,
+# reporting target accuracy, rounds/simulated-seconds-to-target, final
+# accuracy, and accuracy-per-device-round. The grid prices the platform's
+# throughput levers in accuracy terms:
+#
+#   sync_deadline vs async_staleness  — what the 2.19x async headline
+#                                       costs (or doesn't) in quality;
+#   attack_undefended vs
+#   attack_trimmed_mean               — what the defense recovers under a
+#                                       20% scale attack;
+#   clean_resident vs streamed        — streamed execution is bitwise
+#                                       resident execution, so the pair's
+#                                       accuracy/rounds fields MUST agree
+#                                       (asserted into the payload's
+#                                       resident_vs_streamed_match; the
+#                                       sim clock differs by design — the
+#                                       streamed row carries a scenario
+#                                       round clock);
+#   drift_trace                       — what unmitigated label drift does
+#                                       to a fixed-eval-set model.
+#
+# CPU runs are degraded measurements (wall-clock fields only; the
+# accuracy/rounds fields are platform-independent for fixed seeds),
+# marked as usual. The grid runs IN-PROCESS (unlike the subprocess
+# sweeps): each row is seconds of tiny training (only the on-disk XLA
+# cache is shared between rows — every row builds its own FedCore), so
+# per-family process isolation would cost more than it protects.
+
+CONVERGENCE_BASE = dict(
+    seed=7, num_clients=256, n_local=8, input_shape=(32,), num_classes=10,
+    class_sep=2.5, eval_n=1024, rounds=24, batch=8, local_steps=6,
+    block_clients=32, hidden=(32,), local_lr=0.3,
+)
+CONVERGENCE_TRACK = {
+    "target_accuracy": 0.7,
+    "eval_every": 1,
+    "round_budget": 12,
+    "sim_seconds_budget": 5.0,
+}
+# Completion-time model shared by the sync-deadline and async rows: the
+# IDENTICAL speed distribution, so the pair isolates the commit policy
+# (the deadline masks ~20% of arrivals as stragglers; the async engine
+# commits them with staleness-discounted weights instead).
+_CONV_PACING = dict(default_step_s=0.05, jitter=0.5)
+_CONV_ATTACK = {"mode": "scale", "factor": 80.0, "fraction": 0.2}
+_CONV_DEFENSE = {"clip_norm": 3.0, "aggregator": "trimmed_mean",
+                 "trim_fraction": 0.25}
+
+CONVERGENCE_FAMILIES = [
+    dict(name="conv_mlp_clean_resident"),
+    dict(name="conv_mlp_streamed", streamed=True),
+    dict(name="conv_mlp_sync_deadline",
+         deadline=dict(deadline_s=0.42, **_CONV_PACING)),
+    dict(name="conv_mlp_async_staleness",
+         async_config=dict(buffer_size=64, schedule="polynomial",
+                           staleness_alpha=0.5, **_CONV_PACING)),
+    dict(name="conv_mlp_attack_undefended", attack=dict(_CONV_ATTACK)),
+    dict(name="conv_mlp_attack_trimmed_mean", attack=dict(_CONV_ATTACK),
+         defense=dict(_CONV_DEFENSE)),
+    dict(name="conv_mlp_drift_trace",
+         scenario={"drift_period_rounds": 5, "round_seconds": 600.0}),
+]
+
+
+def run_convergence_bench(out_name="BENCH_convergence.json"):
+    """Capture the (family x engine-config) convergence grid; one JSON
+    line per row, banked atomically like the other sweeps."""
+    from olearning_sim_tpu.engine.convergence import run_convergence_task
+
+    backend, degraded = select_backend()
+    degraded = degraded or backend != "tpu"
+    entries = []
+    for fam in CONVERGENCE_FAMILIES:
+        fam = dict(fam)
+        name = fam.pop("name")
+        try:
+            record = run_convergence_task(
+                name=name, convergence=dict(CONVERGENCE_TRACK),
+                **CONVERGENCE_BASE, **fam,
+            )
+            # The full eval series stays out of the bank (it is the
+            # gate's job); the banked row keeps the summary facts.
+            record.pop("evals", None)
+        except Exception as e:  # noqa: BLE001 — bank what we measured
+            record = {"family": name, "error": str(e)[-500:]}
+        record.update(backend=backend, degraded=degraded)
+        record.setdefault("captured_unix", round(time.time(), 1))
+        print(json.dumps(record), flush=True)
+        entries.append(record)
+
+    def _pair(a, b, key="final_accuracy"):
+        by = {e.get("family"): e for e in entries}
+        ea, eb = by.get(a, {}), by.get(b, {})
+        if ea.get(key) is None or eb.get(key) is None:
+            return None
+        return round(float(ea[key]) - float(eb[key]), 6)
+
+    def _streamed_matches_resident():
+        # The standing sanity claim, asserted rather than implied: the
+        # streamed row's accuracy/rounds fields equal the resident row's
+        # EXACTLY (streamed execution is bitwise resident execution; sim/
+        # wall clocks are excluded — the streamed row carries a scenario
+        # round clock by design). None = a row errored; False = the
+        # bitwise contract broke and this artifact says so loudly.
+        by = {e.get("family"): e for e in entries}
+        a = by.get("conv_mlp_clean_resident", {})
+        b = by.get("conv_mlp_streamed", {})
+        if "error" in a or "error" in b or not a or not b:
+            return None
+        return all(
+            a.get(k) == b.get(k)
+            for k in ("final_accuracy", "best_accuracy",
+                      "accuracy_at_round_budget", "reached",
+                      "rounds_to_target", "device_rounds_committed")
+        )
+
+    payload = {
+        "captured_unix": round(time.time(), 1),
+        "backend": backend,
+        "degraded": degraded,
+        "target_accuracy": CONVERGENCE_TRACK["target_accuracy"],
+        "note": ("Time-to-accuracy grid: per (family x engine-config) "
+                 "convergence run to a fixed seed/budget — rounds and "
+                 "simulated-seconds to the target accuracy, accuracy at "
+                 "fixed round budget, accuracy per device-round. The "
+                 "accuracy/rounds fields are platform-independent for "
+                 "fixed seeds; wall-clock fields on CPU are degraded "
+                 "measurements (methodology: docs/performance.md, "
+                 "Time-to-accuracy benching)."),
+        # Headline deltas: positive = the first row is more accurate.
+        "async_minus_sync_final_accuracy": _pair(
+            "conv_mlp_async_staleness", "conv_mlp_sync_deadline"),
+        "defended_minus_undefended_final_accuracy": _pair(
+            "conv_mlp_attack_trimmed_mean", "conv_mlp_attack_undefended"),
+        "resident_vs_streamed_match": _streamed_matches_resident(),
+        "entries": entries,
+    }
+    _bank(payload, out_name)
+    return payload
+
+
 if __name__ == "__main__":
     if "--chips" in sys.argv:
         # Subdivide the host for every family this invocation measures
@@ -1543,6 +1693,8 @@ if __name__ == "__main__":
         run_async_bench()
     elif "--trace" in sys.argv:
         run_trace_bench()
+    elif "--convergence" in sys.argv:
+        run_convergence_bench()
     elif "--family" in sys.argv:
         run_family_once(sys.argv[sys.argv.index("--family") + 1])
     else:

@@ -2584,7 +2584,7 @@ class FedCore:
                      completion_time=None, deadline=None,
                      attack_scale=None, defense=None,
                      label_shift=None, label_classes=None,
-                     feature_dtype=jnp.bfloat16):
+                     feature_dtype=jnp.bfloat16, tracer=None):
         """Advance one FL round over a host-resident
         :class:`~olearning_sim_tpu.engine.client_data.HostClientStore`,
         streaming the cohort through the device in blocks of
@@ -2603,10 +2603,19 @@ class FedCore:
         Bitwise contract: for the same cohort, padded size, and
         ``block_clients``, a >=2-block streamed round produces bit-for-bit
         the params, metrics, and per-client losses of the resident
-        single-program round (regression-tested)."""
+        single-program round (regression-tested).
+
+        ``tracer`` — a :class:`~olearning_sim_tpu.telemetry.SpanTracer`
+        (default tracer when None): each block emits a ``stream_stage``
+        span around its host->device placement and a ``stream_step`` span
+        around its partial-step dispatch, so the double-buffered overlap
+        is visible in the Perfetto export next to the runner's round
+        spans."""
         import time as _time
 
-        from olearning_sim_tpu.telemetry import instrument
+        from olearning_sim_tpu.telemetry import default_tracer, instrument
+
+        tracer = tracer if tracer is not None else default_tracer()
 
         if stream_rows is None:
             raise ValueError(
@@ -2631,28 +2640,31 @@ class FedCore:
         rowmaps = [None] * nb
 
         t0 = _time.perf_counter()
-        placed, extras, nbytes, rows_idx = self._place_stream_block(
-            store, prep, 0, feature_dtype
-        )
+        with tracer.span("stream_stage", block=0):
+            placed, extras, nbytes, rows_idx = self._place_stream_block(
+                store, prep, 0, feature_dtype
+            )
         first_transfer_s = _time.perf_counter() - t0
         transfer_s += first_transfer_s
         transfer_bytes += nbytes
         block_bytes0 = nbytes
         for i in range(nb):
             rowmaps[i] = rows_idx
-            acc, losses[i] = partial_fn(
-                state.params, state.base_key, state.round_idx, acc,
-                *placed, *extras,
-            )
+            with tracer.span("stream_step", block=i):
+                acc, losses[i] = partial_fn(
+                    state.params, state.base_key, state.round_idx, acc,
+                    *placed, *extras,
+                )
             if i + 1 < nb:
                 # Double buffering: stage the next block while the
                 # current block's compiled step is in flight. HBM holds
                 # at most two staged blocks (the previous block's
                 # buffers die with their last reference).
                 t0 = _time.perf_counter()
-                placed, extras, nbytes, rows_idx = \
-                    self._place_stream_block(store, prep, i + 1,
-                                             feature_dtype)
+                with tracer.span("stream_stage", block=i + 1):
+                    placed, extras, nbytes, rows_idx = \
+                        self._place_stream_block(store, prep, i + 1,
+                                                 feature_dtype)
                 transfer_s += _time.perf_counter() - t0
                 transfer_bytes += nbytes
         new_state, metrics = prep["finalize_fn"](state, acc)

@@ -51,6 +51,10 @@ from olearning_sim_tpu.deviceflow.trace_compiler import (
     compile_trace,
 )
 from olearning_sim_tpu.engine.client_data import ClientDataset, HostClientStore
+from olearning_sim_tpu.engine.convergence import (
+    ConvergenceConfig,
+    ConvergenceTracker,
+)
 from olearning_sim_tpu.engine.scenario import ScenarioConfig, ScenarioModel
 from olearning_sim_tpu.engine.defense import DefenseConfig
 from olearning_sim_tpu.engine.fedcore import FedCore
@@ -163,6 +167,9 @@ class SimulationRunner:
         quarantine_preseed: Optional[Dict[str, List[int]]] = None,
         async_config: Optional[Any] = None,
         scenario: Optional[ScenarioConfig] = None,
+        convergence: Optional[ConvergenceConfig] = None,
+        cost_oracle: Optional[Any] = None,
+        cost_family: Optional[str] = None,
     ):
         """``model_io`` — a :class:`ModelUpdateExporter` realizing the
         reference's model-update-style convention (round r's global model
@@ -187,7 +194,15 @@ class SimulationRunner:
         feedback loop; None keeps aggregation bitwise identical to the
         pre-defense engine. ``quarantine_preseed`` — map of population name
         → known-bad client ids blocklisted from round 0 (engine params
-        ``{"quarantine": {"preseed": ...}}``)."""
+        ``{"quarantine": {"preseed": ...}}``). ``convergence`` — opt-in
+        time-to-accuracy tracking
+        (:class:`~olearning_sim_tpu.engine.convergence.ConvergenceConfig`):
+        quality series at the configured eval cadence, time-to-target in
+        simulated and wall time, state riding checkpoint meta.
+        ``cost_oracle`` / ``cost_family`` — a
+        :class:`~olearning_sim_tpu.taskmgr.pool.CostOracle` fed this
+        task's measured per-round wall time at every round close (the
+        telemetry→scheduler feedback loop)."""
         self.task_id = task_id
         self.core = core
         self.populations = populations
@@ -353,6 +368,26 @@ class SimulationRunner:
                     "defense: robust aggregators / anomaly scoring need "
                     "every client's delta resident (docs/performance.md)"
                 )
+        # Convergence observability (engine/convergence.py): the per-round
+        # quality series, evaluated at the configured cadence, with
+        # time-to-target and accuracy-at-budget in simulated and wall
+        # time. Tracker state rides per-round history records ->
+        # checkpoint meta like the deadline/quarantine/async clocks
+        # (_reconverge), so a supervisor-resumed run replays the record.
+        self._convergence: Optional[ConvergenceTracker] = (
+            ConvergenceTracker(convergence)
+            if convergence is not None and convergence.enabled else None
+        )
+        self._convergence_warned = False
+        # Telemetry->scheduler feedback: a CostOracle (taskmgr/pool.py)
+        # fed the measured per-round wall time at every round close, so
+        # the chip-pool scheduler packs from live numbers instead of only
+        # bench ingests (_feed_cost: steady rounds feed round_time_s;
+        # round 0 feeds compile_s only when it was compile-dominated).
+        self._cost_oracle = cost_oracle
+        self._cost_family = cost_family
+        self._cost_round0_wall: Optional[float] = None
+        self._cost_compile_fed = False
         # run()-loop state for the cooperative stepping API (begin/step/
         # finish) the MultiTaskDispatcher drives; None outside a run.
         self._loop: Optional[Dict[str, Any]] = None
@@ -932,6 +967,13 @@ class SimulationRunner:
                 ).labels(task_id=self.task_id).observe_many(
                     aplan.window[:real][committed_mask].astype(np.float64)
                 )
+                # Simulated makespan of the async round (last committed
+                # update's arrival = the final buffer commit's clock) —
+                # the convergence tracker's simulated-time denominator,
+                # comparable with the sync path's round_close_s.
+                rec["round_close_s"] = float(
+                    async_completion[committed_mask].max()
+                )
             instrument(
                 "ols_engine_idle_seconds_total", self.registry
             ).labels(task_id=self.task_id, mode="async").inc(idle)
@@ -1024,6 +1066,7 @@ class SimulationRunner:
                 state, p.store,
                 stream_rows=self.scenario.stream_block_rows,
                 participate=mask[:real], num_steps=p.num_steps,
+                tracer=self.tracer,
                 **kwargs,
             )
             self.states[p.name] = state
@@ -1105,6 +1148,156 @@ class SimulationRunner:
             ).labels(task_id=self.task_id).observe_many(finite)
             self._pacer.observe(finite)
         return rec
+
+    # ------------------------------------------------------------ convergence
+    def _observe_convergence(self, round_idx: int,
+                             round_record: Dict[str, Any],
+                             wall_s: float) -> None:
+        """Advance the convergence clocks for this completed round and, at
+        the configured cadence, record an eval point. The quality value
+        comes from an eval operator's existing ``eval_loss``/``eval_acc``
+        record when this round produced one; otherwise the tracker
+        evaluates the global model directly on the first population with
+        held-out eval data. The cadence and target are host-side data —
+        no compiled program depends on them (asserted in
+        tests/test_convergence.py)."""
+        from olearning_sim_tpu.telemetry import instrument
+
+        tracker = self._convergence
+        # Simulated round duration: the longest population's round close
+        # (deadline rounds) or dispatch-trace duration this round.
+        sim_s = 0.0
+        for op in self.operators:
+            if op.kind != "train":
+                continue
+            for rec in (round_record.get(op.name) or {}).values():
+                dur = rec.get("round_close_s")
+                if dur is None:
+                    dur = rec.get("sim_duration_s")
+                if dur:
+                    sim_s = max(sim_s, float(dur))
+        tracker.observe_round(round_idx, sim_s, wall_s)
+        if not tracker.should_eval(round_idx, self.rounds):
+            return
+        eval_loss = eval_acc = None
+        for op in self.operators:
+            for rec in (round_record.get(op.name) or {}).values():
+                if isinstance(rec, dict) and rec.get("eval_acc") is not None:
+                    eval_loss, eval_acc = rec.get("eval_loss"), rec["eval_acc"]
+                    break
+            if eval_acc is not None:
+                break
+        t_eval0 = time.perf_counter()
+        if eval_acc is None:
+            for p in self.populations:
+                if p.eval_data is not None:
+                    x, y = p.eval_data
+                    with self._phase("convergence", "eval", round_idx):
+                        eval_loss, eval_acc = self.core.evaluate(
+                            self.states[p.name].params, x, y
+                        )
+                    break
+        if eval_acc is None:
+            if not self._convergence_warned:
+                self._convergence_warned = True
+                self.logger.warning(
+                    task_id=self.task_id, system_name="engine",
+                    module_name="runner",
+                    message="convergence tracking enabled but no "
+                            "population has eval_data and no eval "
+                            "operator ran; the quality series stays "
+                            "empty",
+                )
+            return
+        tracker.observe_eval(round_idx, eval_loss, eval_acc)
+        instrument("ols_engine_eval_accuracy", self.registry).labels(
+            task_id=self.task_id
+        ).set(float(eval_acc))
+        # Published on every reached eval, not only the reach transition:
+        # a supervisor-resumed process rehydrates reached=True from
+        # checkpoint meta and must re-expose the to-target gauges in ITS
+        # registry too (idempotent sets of the same committed values).
+        if tracker.reached:
+            if tracker.sim_seconds_to_target is not None:
+                # None = the config has no simulated clock (no deadline/
+                # async/scenario pacing) — publishing 0.0 would read as
+                # "reached instantaneously".
+                instrument(
+                    "ols_engine_time_to_target_seconds", self.registry
+                ).labels(task_id=self.task_id, clock="sim").set(
+                    tracker.sim_seconds_to_target
+                )
+            instrument(
+                "ols_engine_time_to_target_seconds", self.registry
+            ).labels(task_id=self.task_id, clock="wall").set(
+                tracker.wall_seconds_to_target
+            )
+            instrument(
+                "ols_engine_rounds_to_target", self.registry
+            ).labels(task_id=self.task_id).set(tracker.rounds_to_target)
+        if self.perf is not None:
+            # A distinct convergence_eval timing row per eval point: the
+            # quality series then rides the PerformanceManager's persisted
+            # rows, so get_performance()["convergence"] answers — and
+            # survives manager restarts — like every throughput number.
+            from olearning_sim_tpu.performancemgr.performance_manager import (
+                RoundTiming,
+            )
+
+            extra = {
+                "eval_acc": float(eval_acc),
+                "sim_s": tracker.sim_seconds_total,
+                "wall_s": tracker.wall_seconds_total,
+                "reached": 1.0 if tracker.reached else 0.0,
+            }
+            if eval_loss is not None:
+                extra["eval_loss"] = float(eval_loss)
+            if tracker.config.target_accuracy is not None:
+                extra["target"] = float(tracker.config.target_accuracy)
+            if tracker.rounds_to_target is not None:
+                extra["rounds_to_target"] = float(tracker.rounds_to_target)
+                if tracker.sim_seconds_to_target is not None:
+                    extra["sim_s_to_target"] = float(
+                        tracker.sim_seconds_to_target
+                    )
+                extra["wall_s_to_target"] = float(
+                    tracker.wall_seconds_to_target
+                )
+            self.perf.record_round(RoundTiming(
+                task_id=self.task_id, round_idx=round_idx,
+                operator="convergence_eval",
+                duration_s=time.perf_counter() - t_eval0,
+                extra=extra,
+            ))
+
+    def _feed_cost(self, round_wall_s: float) -> None:
+        """Telemetry->scheduler loop: feed this round's measured wall time
+        into the pool's CostOracle the moment the round completes, so the
+        NEXT admission/packing decision for this family runs on live
+        numbers. Round 0's wall is held back until round 1 can classify
+        it: cold builds are compile-dominated there and refine compile_s,
+        but with the persistent XLA compile cache warm round 0 is an
+        ordinary round — feeding it as compile_s would clobber the
+        family's real compile estimate with a near-zero one."""
+        if self._cost_round0_wall is None:
+            self._cost_round0_wall = round_wall_s
+            return
+        self._cost_oracle.record_measurement(
+            self._cost_family, round_time_s=round_wall_s
+        )
+        if not self._cost_compile_fed:
+            self._cost_compile_fed = True
+            if self._cost_round0_wall > 1.5 * round_wall_s:
+                self._cost_oracle.record_measurement(
+                    self._cost_family, compile_s=self._cost_round0_wall
+                )
+
+    def convergence_record(self) -> Optional[Dict[str, Any]]:
+        """The task's convergence record (engine/convergence.py), or None
+        when tracking is off."""
+        if self._convergence is None:
+            return None
+        return self._convergence.record()
 
     def _run_eval(self, p: DataPopulation) -> Dict[str, Any]:
         rec: Dict[str, Any] = {"eval_loss": None, "eval_acc": None}
@@ -1260,6 +1453,7 @@ class SimulationRunner:
         self._repace()
         self._requarantine()
         self._reasync()
+        self._reconverge()
         self.logger.info(
             task_id=self.task_id, system_name="engine", module_name="runner",
             message=f"resumed from checkpoint: round {last_round} complete",
@@ -1399,6 +1593,7 @@ class SimulationRunner:
         self.history = list(snap["history"])
         self._repace()
         self._reasync()
+        self._reconverge()
         if self._quarantine is not None and snap["quarantine"] is not None:
             self._quarantine.restore(snap["quarantine"])
 
@@ -1424,6 +1619,21 @@ class SimulationRunner:
                 self._async_commit_clock = int(clock)
                 return
         self._async_commit_clock = 0
+
+    def _reconverge(self) -> None:
+        """Rehydrate the convergence tracker from the history just restored
+        (rollback or checkpoint resume): the ordered ``convergence_state``
+        records carry the eval series as increments and the newest one
+        the cumulative clocks/to-target facts, so a resumed run continues
+        — and reports — the identical record instead of re-measuring
+        committed rounds. No carrying records (rollback to round 0,
+        pre-convergence checkpoints) resets the tracker."""
+        if self._convergence is None:
+            return
+        self._convergence.load_history([
+            rec["convergence_state"] for rec in self.history
+            if rec.get("convergence_state") is not None
+        ])
 
     def _requarantine(self) -> None:
         """Rehydrate quarantine (defense) state from the history just
@@ -1729,6 +1939,7 @@ class SimulationRunner:
         from olearning_sim_tpu.telemetry import default_tracer, instrument
 
         tracer = self.tracer if self.tracer is not None else default_tracer()
+        t_round0 = time.perf_counter()
         if not self.operator_flow.start():
             if self.stop_event is not None and self.stop_event.is_set():
                 return "stop"  # barrier abandoned due to stop request
@@ -1815,6 +2026,11 @@ class SimulationRunner:
             round_record[operator.name] = op_record
             self._round_outputs[operator.name] = op_record
 
+        round_wall_s = time.perf_counter() - t_round0
+        if self._convergence is not None:
+            self._observe_convergence(round_idx, round_record, round_wall_s)
+        if self._cost_oracle is not None and self._cost_family:
+            self._feed_cost(round_wall_s)
         if self._pacer is not None and self.deadline.adaptive:
             # Controller state after this round's observations. History
             # records ride both the in-memory snapshot and the checkpoint
@@ -1831,6 +2047,11 @@ class SimulationRunner:
             # rides checkpoint meta the same way, so a resumed run reports
             # a continuous commit sequence (_reasync).
             round_record["async_clock"] = self._async_commit_clock
+        if self._convergence is not None:
+            # Convergence tracker state (clocks, eval series, to-target
+            # facts) rides checkpoint meta so a supervisor-resumed run
+            # reports the identical time-to-target record (_reconverge).
+            round_record["convergence_state"] = self._convergence.state_json()
         self.history.append(round_record)
         # A preemption here ("runner.pre_checkpoint") dies with the round's
         # work done but not yet durable — the classic lost-round scenario the
@@ -2122,6 +2343,22 @@ class MultiTaskDispatcher:
         if self.task_repo is not None:
             self.task_repo.release_lease(runner.task_id, self.owner_id)
 
+    @staticmethod
+    def _retire(runner: SimulationRunner) -> None:
+        """Retire a FINISHED task's per-task metric series from its
+        registry — a dispatcher multiplexing a stream of tasks on one
+        long-lived process otherwise leaks one labeled series
+        (ols_engine_idle_seconds_total{task_id,...}, round histograms)
+        per completed task. Fenced/errored tasks keep their series: they
+        are not terminal here (the reclaimer/supervisor owns them)."""
+        from olearning_sim_tpu.telemetry import default_registry
+
+        # getattr: dispatcher tests drive duck-typed stub runners that
+        # carry no telemetry sink.
+        reg = getattr(runner, "registry", None)
+        reg = reg if reg is not None else default_registry()
+        reg.retire_label_value("task_id", runner.task_id)
+
     def _fence(self, runner: SimulationRunner) -> None:
         """Another process owns the task now: stop locally, cede the row
         (no release — the lease belongs to the new owner)."""
@@ -2213,6 +2450,7 @@ class MultiTaskDispatcher:
                     errors[r.task_id] = e
                     continue
                 self._release(r)
+                self._retire(r)
         if errors:
             for tid, e in errors.items():
                 self.logger.error(
@@ -2283,6 +2521,7 @@ class MultiTaskDispatcher:
                 results.pop(r.task_id, None)
             elif r.task_id in results:
                 self._release(r)
+                self._retire(r)
         if errors:
             first = next(iter(errors.values()))
             for tid, e in errors.items():

@@ -145,9 +145,17 @@ def build_runner_from_taskconfig(
     stop_event: Optional["threading.Event"] = None,
     perf=None,
     checkpointer=None,
+    cost_oracle=None,
+    registry=None,
 ) -> SimulationRunner:
     """Build a ready-to-run SimulationRunner from a TaskConfig proto or the
-    equivalent task JSON."""
+    equivalent task JSON. ``cost_oracle`` — a
+    :class:`~olearning_sim_tpu.taskmgr.pool.CostOracle` the runner feeds
+    measured per-round wall times into (the chip-pool scheduler's live
+    telemetry loop); the family key follows ``CostOracle.family_of``.
+    ``registry`` — the telemetry MetricsRegistry the runner instruments
+    into (None = process default); pass the same instance the embedding
+    TaskManager retires finished tasks' series from."""
     if not isinstance(tc, pb.TaskConfig):
         tc = json2taskconfig(tc)
     # Persistent XLA compilation cache: every task-bridge build (fresh
@@ -489,6 +497,16 @@ def build_runner_from_taskconfig(
 
         async_config = AsyncConfig.from_dict(params["async"])
 
+    # Convergence tracking rides the same blob (docs/performance.md
+    # "Time-to-accuracy benching"):
+    #   {"convergence": {"target_accuracy": 0.9, "eval_every": 5,
+    #                    "round_budget": 40, "sim_seconds_budget": 1800}}
+    convergence = None
+    if params.get("convergence"):
+        from olearning_sim_tpu.engine.convergence import ConvergenceConfig
+
+        convergence = ConvergenceConfig.from_dict(params["convergence"])
+
     # Operator blocklists: {"quarantine": {"preseed": {"data_0": [3, 7]}}}
     # — known-bad device ids quarantined from round 0 (validated again by
     # the runner against the actual population sizes).
@@ -523,4 +541,16 @@ def build_runner_from_taskconfig(
         quarantine_preseed=quarantine_preseed,
         async_config=async_config,
         scenario=scenario,
+        convergence=convergence,
+        cost_oracle=cost_oracle,
+        cost_family=(_cost_family(tc) if cost_oracle is not None else None),
+        registry=registry,
     )
+
+
+def _cost_family(tc: pb.TaskConfig) -> str:
+    """The CostOracle family key for this task (lazy import: the bridge
+    must not pull taskmgr in for pool-less builds)."""
+    from olearning_sim_tpu.taskmgr.pool import CostOracle
+
+    return CostOracle.family_of(tc)

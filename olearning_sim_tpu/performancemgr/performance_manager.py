@@ -255,17 +255,60 @@ class PerformanceManager:
         if not rows:
             return {"task_id": task_id, "rounds_recorded": 0,
                     "resilience": resilience}
-        durations = sorted(t.duration_s for t in rows)
+        # Convergence-tracker eval rows feed ONLY the convergence block:
+        # they are synthetic observability rows, and counting them in the
+        # throughput aggregates would make the same workload report
+        # different round_time_s / rounds_per_sec with tracking on vs
+        # off (breaking comparability with every banked number).
+        timing_rows = [t for t in rows if t.operator != "convergence_eval"]
+        if not timing_rows:
+            timing_rows = rows
+        durations = sorted(t.duration_s for t in timing_rows)
         total_time = sum(durations)
-        total_clients = sum(t.num_clients for t in rows)
-        distinct_rounds = len({t.round_idx for t in rows})
+        total_clients = sum(t.num_clients for t in timing_rows)
+        distinct_rounds = len({t.round_idx for t in timing_rows})
+
+        def _convergence() -> Optional[Dict[str, Any]]:
+            # Quality series from the runner's convergence_eval timing
+            # rows (one per tracker eval point; extras carry the
+            # accuracy/clock scalars). Dedup by round, last row wins —
+            # a rolled-back round's replay re-records its eval point.
+            latest: Dict[int, RoundTiming] = {}
+            for t in rows:
+                if t.operator == "convergence_eval":
+                    latest[t.round_idx] = t
+            if not latest:
+                return None
+            series = [
+                {"round": r, "acc": t.extra.get("eval_acc"),
+                 "loss": t.extra.get("eval_loss"),
+                 "sim_s": t.extra.get("sim_s"),
+                 "wall_s": t.extra.get("wall_s")}
+                for r, t in sorted(latest.items())
+            ]
+            newest = latest[max(latest)]
+            accs = [p["acc"] for p in series if p["acc"] is not None]
+            out: Dict[str, Any] = {
+                "evals": len(series),
+                "final_accuracy": accs[-1] if accs else None,
+                "best_accuracy": max(accs) if accs else None,
+                "reached": bool(newest.extra.get("reached")),
+                "series": series,
+            }
+            for src, dst in (("target", "target_accuracy"),
+                             ("rounds_to_target", "rounds_to_target"),
+                             ("sim_s_to_target", "sim_seconds_to_target"),
+                             ("wall_s_to_target", "wall_seconds_to_target")):
+                if src in newest.extra:
+                    out[dst] = newest.extra[src]
+            return out
 
         def _extra_total(key: str) -> int:
             # Dedup by (round, operator), last row wins: a rolled-back round
             # that replays records a second timing row for the same round,
             # and summing both would double-count its stragglers/drops.
             latest: Dict[Any, RoundTiming] = {}
-            for t in rows:
+            for t in timing_rows:
                 latest[(t.round_idx, t.operator)] = t
             return sum(int(t.extra.get(key, 0) or 0)
                        for t in latest.values())
@@ -273,7 +316,7 @@ class PerformanceManager:
         return {
             "task_id": task_id,
             "rounds_recorded": distinct_rounds,
-            "operator_executions": len(rows),
+            "operator_executions": len(timing_rows),
             "total_time_s": total_time,
             "rounds_per_sec": distinct_rounds / total_time if total_time else 0.0,
             "device_rounds_per_sec": total_clients / total_time if total_time else 0.0,
@@ -283,7 +326,7 @@ class PerformanceManager:
                 "p95": _percentile(durations, 0.95),
                 "max": durations[-1],
             },
-            "per_client_step_latency_s": _mean_step_latency(rows),
+            "per_client_step_latency_s": _mean_step_latency(timing_rows),
             # Deadline-aware rounds: clients that missed the round deadline
             # (stragglers) reported distinctly from trace-level drops.
             "stragglers_total": _extra_total("stragglers"),
@@ -295,6 +338,10 @@ class PerformanceManager:
                 "flagged_total": _extra_total("flagged"),
                 "attacked_total": _extra_total("attacked"),
             },
+            # Time-to-accuracy: the convergence tracker's quality series
+            # and to-target facts (None when tracking is off for the
+            # task) — docs/performance.md "Time-to-accuracy benching".
+            "convergence": _convergence(),
             "resilience": resilience,
         }
 

@@ -105,6 +105,7 @@ class TaskManager:
         pool=None,
         rebalance_interval: float = 2.0,
         adopt_stranded_after: Optional[float] = None,
+        registry=None,
     ):
         """``runner_factory(task_config, task_repo, deviceflow, stop_event)``
         builds the engine runner for a scheduled task; defaults to the
@@ -127,6 +128,9 @@ class TaskManager:
         self._deviceflow = deviceflow
         self._phone_client = phone_client
         self._perf = perf
+        # Telemetry registry for per-task series retention (None resolves
+        # the process default at use time).
+        self._registry = registry
         self._task_queue = TaskQueue()
         # Chip-pool control plane (taskmgr/pool.py): when a PoolScheduler
         # is supplied it IS the strategy, and additionally gates submission
@@ -281,6 +285,15 @@ class TaskManager:
         return build_runner_from_taskconfig(
             tc, task_repo=self._task_repo, deviceflow=self._deviceflow,
             stop_event=stop_event, perf=self._perf,
+            # Telemetry->scheduler loop: with a pool scheduler attached,
+            # every round's measured wall time refines the family's cost
+            # estimate for the NEXT admission/packing decision — live
+            # numbers, not only bench ingests (taskmgr/pool.py).
+            cost_oracle=(self._pool.oracle if self._pool is not None
+                         else None),
+            # The runner publishes into the same registry this manager
+            # retires finished tasks' series from (series retention).
+            registry=self._registry,
         )
 
     # ------------------------------------------------------------------ RPCs
@@ -916,6 +929,20 @@ class TaskManager:
             self._cleanup_hybrid_staging(task_id)
             if self._pool is not None:
                 self._pool.on_finished(task_id)
+            # Series retention: the finished task's per-task label series
+            # (ols_engine_*{task_id=...}, ols_resilience_events_total)
+            # are retired — a long-lived server otherwise leaks one
+            # labeled series per completed task forever.
+            self._retire_task_series(task_id)
+
+    def _retire_task_series(self, task_id: str) -> None:
+        """Drop every metric series labeled with this (terminal) task's id
+        from the registry (MetricsRegistry.retire_label_value)."""
+        from olearning_sim_tpu.telemetry import default_registry
+
+        reg = (self._registry if self._registry is not None
+               else default_registry())
+        reg.retire_label_value("task_id", task_id)
 
     def heartbeat_once(self, now: Optional[float] = None) -> None:
         """Renew the lease of every task this process owns whose engine job

@@ -179,6 +179,9 @@ def validate_correctness(request) -> Tuple[bool, str]:
                         from olearning_sim_tpu.engine.async_rounds import (
                             AsyncConfig,
                         )
+                        from olearning_sim_tpu.engine.convergence import (
+                            ConvergenceConfig,
+                        )
                         from olearning_sim_tpu.engine.defense import (
                             DefenseConfig,
                         )
@@ -225,6 +228,7 @@ def validate_correctness(request) -> Tuple[bool, str]:
                             ("async", AsyncConfig.from_dict),
                             ("parallel", ParallelConfig.from_dict),
                             ("scenario", ScenarioConfig.from_dict),
+                            ("convergence", ConvergenceConfig.from_dict),
                         ):
                             if not op_params.get(block):
                                 continue

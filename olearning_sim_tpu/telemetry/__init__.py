@@ -189,6 +189,29 @@ CATALOG = {
         "pacing EMAs, personalization state)",
         ("algorithm",),
     ),
+    "ols_engine_eval_accuracy": (
+        GAUGE,
+        "Held-out eval accuracy of the global model at the last "
+        "convergence-tracker eval point (fraction correct in [0, 1]; "
+        "engine/convergence.py — the quality denominator behind every "
+        "throughput number)",
+        ("task_id",),
+    ),
+    "ols_engine_time_to_target_seconds": (
+        GAUGE,
+        "Seconds until eval accuracy first reached the configured "
+        "convergence target, per clock (clock=sim: simulated fleet "
+        "time; clock=wall: measured host time). Unset until the target "
+        "is reached",
+        ("task_id", "clock"),
+    ),
+    "ols_engine_rounds_to_target": (
+        GAUGE,
+        "Train rounds until eval accuracy first reached the configured "
+        "convergence target (the rounds-denominated time-to-accuracy "
+        "figure BENCH_convergence.json banks). Unset until reached",
+        ("task_id",),
+    ),
     "ols_engine_compile_cache_hits_total": (
         COUNTER,
         "Compiled executables deserialized from the persistent XLA "

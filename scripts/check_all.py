@@ -19,6 +19,12 @@ injection_points      chaos points documented + tested
 tp_coverage           every mp>1 task config shards >=50% of parameter
                       elements (analysis/tp_coverage; pure eval_shape,
                       no compile)
+convergence           model quality vs blessed envelopes: a fixed-seed
+                      convergence grid (clean / async / attacked+defended
+                      / attacked-undefended / drift) re-run and diffed
+                      against analysis/convergence.json
+                      (analysis/convergence_gate; ~15 s of tiny CPU
+                      training — --skip it for a sub-second lint pass)
 hlo_collectives       defended program has no O(clients x params)
                       all-gather (scripts/check_hlo_collectives; shares
                       the grid compile below)
@@ -86,6 +92,7 @@ def build_registry(grid_artifacts=None):
 
     from olearning_sim_tpu.analysis import (
         ast_rules,
+        convergence_gate,
         hlo_audit,
         retrace,
         tp_coverage,
@@ -120,6 +127,7 @@ def build_registry(grid_artifacts=None):
         "event_kinds": check_event_kinds.check,
         "injection_points": check_injection_points.check,
         "tp_coverage": tp_coverage.check,
+        "convergence": convergence_gate.check,
         "hlo_collectives": hlo_collectives_check,
         "hlo_audit": lambda: hlo_audit.check(artifacts_by_name=arts()),
         "retrace": lambda: retrace.check(artifacts_by_name=arts()),
@@ -162,6 +170,10 @@ def main(argv=None) -> int:
                     help="re-measure the variant grid and rewrite "
                          "analysis/budgets.json (after an INTENTIONAL "
                          "program change; commit the diff)")
+    ap.add_argument("--bless-convergence", action="store_true",
+                    help="re-run the convergence gate grid and rewrite "
+                         "analysis/convergence.json (after an INTENTIONAL "
+                         "quality change; commit the diff)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -174,6 +186,13 @@ def main(argv=None) -> int:
         budgets = hlo_audit.bless()
         print(f"check_all: blessed {len(budgets['variants'])} variants "
               f"-> {hlo_audit.BUDGETS_PATH}")
+        return 0
+    if args.bless_convergence:
+        from olearning_sim_tpu.analysis import convergence_gate
+
+        envelopes = convergence_gate.bless()
+        print(f"check_all: blessed {len(envelopes['entries'])} convergence "
+              f"entries -> {convergence_gate.ENVELOPES_PATH}")
         return 0
 
     only = args.only.split(",") if args.only else None

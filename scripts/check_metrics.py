@@ -41,6 +41,17 @@ SUBSYSTEMS = {
 UNITS = {
     "total", "seconds", "bytes", "ratio", "info", "depth", "batches",
     "messages", "clients", "rounds", "count",
+    # Model quality (fraction correct in [0, 1]) — the convergence
+    # tracker's eval gauge (ols_engine_eval_accuracy).
+    "accuracy",
+}
+# Per-metric exemptions from the unit-suffix rule: names whose trailing
+# token is part of a compound noun, not a unit. Each entry is a
+# deliberate one-off (NEVER a suffix pattern — whitelisting "target" as
+# a unit would let any future unitless ..._target misname slip through).
+SUFFIX_EXEMPT = {
+    # "rounds to target": the dimension is the middle token (rounds).
+    "ols_engine_rounds_to_target",
 }
 NAME_RE = re.compile(r"^ols_[a-z0-9]+(_[a-z0-9]+)+$")
 
@@ -78,7 +89,7 @@ def check(catalog=None, pkg=None) -> list:
                 f"{name}: unknown subsystem {parts[1]!r} "
                 f"(known: {sorted(SUBSYSTEMS)})"
             )
-        if parts[-1] not in UNITS:
+        if parts[-1] not in UNITS and name not in SUFFIX_EXEMPT:
             problems.append(
                 f"{name}: unit suffix {parts[-1]!r} not in {sorted(UNITS)}"
             )

@@ -318,7 +318,8 @@ def test_stream_rejections(plan, host_ds, core):
 
 # --------------------------------------------------- runner integration
 def _stream_runner(core, host_ds, scenario, *, rounds, task_id,
-                   ckpt=None, resilience=None, eval_data=None):
+                   ckpt=None, resilience=None, eval_data=None,
+                   tracer=None):
     pop = DataPopulation(
         name="data_0",
         dataset=host_ds,
@@ -333,7 +334,7 @@ def _stream_runner(core, host_ds, scenario, *, rounds, task_id,
         task_id=task_id, core=core, populations=[pop],
         operators=[OperatorSpec(name="train")], rounds=rounds,
         checkpointer=ckpt, scenario=scenario, resilience=resilience,
-        trace_seed=13,
+        trace_seed=13, tracer=tracer,
     )
 
 
@@ -341,6 +342,33 @@ SCENARIO = ScenarioConfig(
     online_base=0.6, online_amp=0.3, leave_rate=0.01,
     drift_period_rounds=3, stream_block_rows=STREAM_ROWS,
 )
+
+
+def test_streamed_round_emits_nested_stream_spans(core, host_ds):
+    """Per-block ``stream_stage`` (host->device placement) and
+    ``stream_step`` (partial-step dispatch) spans nest under the runner's
+    train-phase span, so the double-buffered transfer overlap is visible
+    in the Perfetto export next to the round timeline."""
+    from olearning_sim_tpu.telemetry import SpanTracer
+
+    tracer = SpanTracer()
+    runner = _stream_runner(core, host_ds, SCENARIO, rounds=1,
+                            task_id="stream-spans", tracer=tracer)
+    runner.run()
+    stages = tracer.spans("stream_stage")
+    steps = tracer.spans("stream_step")
+    # 64 padded clients / 32 stream rows = 2 blocks: one step span per
+    # block, one stage span per staged block (block 0 + the double-
+    # buffered block 1).
+    assert len(steps) == 2 and len(stages) == 2
+    assert [s.attrs["block"] for s in steps] == [0, 1]
+    assert [s.attrs["block"] for s in stages] == [0, 1]
+    train_phase = [s for s in tracer.spans()
+                   if s.name == "round.train.train"]
+    assert len(train_phase) == 1
+    # Every block span is parented inside the train phase span.
+    assert all(s.parent_id == train_phase[0].span_id
+               for s in stages + steps)
 
 
 def test_runner_streamed_scenario_oracle(core, host_ds):
